@@ -1,0 +1,102 @@
+"""Configuration loading and validation.
+
+Keeps the reference's config.yaml schema (env_args / train_args /
+worker_args, reference config.yaml:2-38, docs/parameters.md) so existing
+configs port unchanged, and layers defaults + validation on top (the
+reference has no validation layer).  TPU-specific knobs live under
+``train_args`` with safe defaults:
+
+* ``mesh``: axis-name -> size dict for the device mesh ({'dp': -1} means
+  "all devices data-parallel").
+* ``inference_batch_size``: max cross-environment batch for the actor-side
+  TPU inference engine.
+* ``num_actors`` alias: ``worker.num_parallel``.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+import yaml
+
+DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
+    "turn_based_training": True,
+    "observation": False,
+    "gamma": 0.8,
+    "forward_steps": 16,
+    "burn_in_steps": 0,
+    "compress_steps": 4,
+    "entropy_regularization": 1.0e-1,
+    "entropy_regularization_decay": 0.1,
+    "update_episodes": 200,
+    "batch_size": 128,
+    "minimum_episodes": 400,
+    "maximum_episodes": 100000,
+    "epochs": -1,
+    "num_batchers": 2,
+    "eval_rate": 0.1,
+    "worker": {"num_parallel": 6},
+    "lambda": 0.7,
+    "policy_target": "TD",
+    "value_target": "TD",
+    "eval": {"opponent": ["random"]},
+    "seed": 0,
+    "restart_epoch": 0,
+    # --- TPU-native additions -------------------------------------------
+    "mesh": {"dp": -1},
+    "inference_batch_size": 64,
+    "prefetch_batches": 2,
+    "metrics_path": "metrics.jsonl",
+    "model_dir": "models",
+}
+
+DEFAULT_WORKER_ARGS: Dict[str, Any] = {
+    "server_address": "",
+    "num_parallel": 8,
+}
+
+VALID_TARGETS = ("MC", "TD", "UPGO", "VTRACE")
+
+
+def _deep_merge(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    out = copy.deepcopy(base)
+    for key, value in (override or {}).items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = _deep_merge(out[key], value)
+        else:
+            out[key] = value
+    return out
+
+
+def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
+    train = args["train_args"]
+    for key in ("policy_target", "value_target"):
+        if train[key] not in VALID_TARGETS:
+            raise ValueError(f"{key}={train[key]!r} not one of {VALID_TARGETS}")
+    for key in ("forward_steps", "batch_size", "update_episodes", "compress_steps"):
+        if train[key] <= 0:
+            raise ValueError(f"train_args.{key} must be positive, got {train[key]}")
+    if train["burn_in_steps"] < 0:
+        raise ValueError("train_args.burn_in_steps must be >= 0")
+    if not 0.0 <= train["eval_rate"] <= 1.0:
+        raise ValueError("train_args.eval_rate must be in [0, 1]")
+    if "env" not in args.get("env_args", {}):
+        raise ValueError("env_args.env is required")
+    return args
+
+
+def normalize_args(raw: Dict[str, Any]) -> Dict[str, Any]:
+    """Apply defaults to a raw config dict and validate."""
+    args = {
+        "env_args": copy.deepcopy(raw.get("env_args", {})),
+        "train_args": _deep_merge(DEFAULT_TRAIN_ARGS, raw.get("train_args", {})),
+        "worker_args": _deep_merge(DEFAULT_WORKER_ARGS, raw.get("worker_args", {})),
+    }
+    return validate_args(args)
+
+
+def load_config(path: str = "config.yaml") -> Dict[str, Any]:
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    return normalize_args(raw)

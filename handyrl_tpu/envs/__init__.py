@@ -1,0 +1,37 @@
+"""Environment registry and factories.
+
+Parity with handyrl/environment.py:9-36: known names map to modules, and an
+unknown name is treated as a dotted import path so user environments plug in
+without registration.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict
+
+from .base import BaseEnvironment  # noqa: F401  (re-export)
+
+ENVS = {
+    "TicTacToe": "handyrl_tpu.envs.tictactoe",
+    "Geister": "handyrl_tpu.envs.geister",
+    "ParallelTicTacToe": "handyrl_tpu.envs.parallel_tictactoe",
+    "HungryGeese": "handyrl_tpu.envs.hungry_geese",
+}
+
+
+def _resolve(env_args: Dict[str, Any]):
+    name = env_args["env"]
+    return importlib.import_module(ENVS.get(name, name))
+
+
+def prepare_env(env_args: Dict[str, Any]) -> None:
+    """Run a module-level ``prepare()`` hook once per process, if present."""
+    module = _resolve(env_args)
+    if hasattr(module, "prepare"):
+        module.prepare()
+
+
+def make_env(env_args: Dict[str, Any]) -> BaseEnvironment:
+    module = _resolve(env_args)
+    return module.Environment(env_args)

@@ -1,0 +1,65 @@
+"""Parallel (simultaneous-move) Tic-Tac-Toe.
+
+Parity with reference handyrl/envs/parallel_tictactoe.py:13-59: both players
+submit an action every step; a uniformly random one of the submitted actions
+is applied for its submitter.  Exercises the simultaneous-move path
+(``turns()`` returns every player) with the same observation/net as
+TicTacToe.
+"""
+
+from __future__ import annotations
+
+import random
+
+from .tictactoe import Environment as TicTacToe, ROWS, COLS, WIN_LINES
+
+
+class Environment(TicTacToe):
+    _COLOR_CHAR = {1: "O", -1: "X"}
+
+    def __str__(self):
+        grid = self.cells.reshape(3, 3)
+        lines = ["  " + " ".join(COLS)]
+        for r in range(3):
+            lines.append(ROWS[r] + " " + " ".join(self._GLYPH[int(v)] for v in grid[r]))
+        return "\n".join(lines)
+
+    def step(self, actions):
+        chooser = random.choice(list(actions.keys()))
+        self._apply(actions[chooser], chooser)
+
+    def _apply(self, action, player):
+        color = (self.BLACK, self.WHITE)[player]
+        self.cells[action] = color
+        if any(self.cells[line].sum() == 3 * color for line in WIN_LINES[self._lines_through(action)]):
+            self.winner = color
+        self.history.append((color, action))
+
+    def diff_info(self, player=None):
+        if not self.history:
+            return ""
+        color, action = self.history[-1]
+        return self.action2str(action) + ":" + self._COLOR_CHAR[color]
+
+    def update(self, info, reset):
+        if reset:
+            self.reset()
+        else:
+            move, glyph = info.split(":")
+            self._apply(self.str2action(move), "OX".index(glyph))
+
+    def turn(self):
+        return NotImplementedError()
+
+    def turns(self):
+        return self.players()
+
+
+if __name__ == "__main__":
+    e = Environment()
+    for _ in range(10):
+        e.reset()
+        while not e.terminal():
+            e.step({p: random.choice(e.legal_actions(p)) for p in e.turns()})
+        print(e)
+        print(e.outcome())

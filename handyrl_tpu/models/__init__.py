@@ -1,0 +1,11 @@
+from .nets import SimpleConvNet, GeeseNet, GeisterNet
+from .inference import InferenceModel, RandomModel, init_variables
+
+__all__ = [
+    "SimpleConvNet",
+    "GeeseNet",
+    "GeisterNet",
+    "InferenceModel",
+    "RandomModel",
+    "init_variables",
+]

@@ -1,0 +1,89 @@
+"""Host-facing inference wrappers: numpy in / numpy out, jitted apply.
+
+Replaces the reference's ModelWrapper/RandomModel (handyrl/model.py:33-74).
+Key difference: ``apply`` is jitted once per (module, batch-shape) and runs
+on the accelerator; hosts speak numpy pytrees at the boundary.  The
+batched-across-environments path (see runtime/inference_engine.py) is the
+TPU-first replacement for the reference's per-process batch-1 CPU
+inference.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import tree_map
+
+
+def init_variables(module, env, seed: int = 0):
+    """Initialize model variables from a sample observation of ``env``."""
+    env.reset()
+    obs = env.observation(env.players()[0])
+    obs_b = tree_map(lambda x: jnp.asarray(x)[None], obs)
+    hidden = module.initial_state((1,))
+    return module.init(jax.random.PRNGKey(seed), obs_b, hidden)
+
+
+class InferenceModel:
+    """A (module, variables) pair exposing batched and single inference.
+
+    API kept compatible with the reference wrapper (model.py:50-60):
+    ``inference(obs, hidden)`` is single-sample numpy->numpy;
+    ``inference_batch`` takes/returns batch-leading pytrees.
+    """
+
+    def __init__(self, module, variables):
+        self.module = module
+        self.variables = variables
+
+    @functools.cached_property
+    def _apply(self):
+        return jax.jit(lambda variables, obs, hidden: self.module.apply(variables, obs, hidden))
+
+    def init_hidden(self, batch_dims=()):
+        hidden = self.module.initial_state(tuple(batch_dims))
+        return None if hidden is None else tree_map(np.asarray, hidden)
+
+    def inference_batch(self, obs, hidden=None) -> Dict[str, Any]:
+        outputs = self._apply(self.variables, obs, hidden)
+        return jax.device_get(outputs)
+
+    def inference(self, obs, hidden=None) -> Dict[str, Any]:
+        obs_b = tree_map(lambda x: np.asarray(x)[None], obs)
+        hidden_b = tree_map(lambda x: np.asarray(x)[None], hidden) if hidden is not None else None
+        outputs = self.inference_batch(obs_b, hidden_b)
+        return tree_map(lambda x: x[0], outputs)
+
+
+class RandomModel:
+    """Zero-logit stand-in (uniform policy over legal actions, zero value).
+
+    Role of reference RandomModel (model.py:65-74): served as model_id 0 so
+    early evaluation opponents are well-defined.
+    """
+
+    def __init__(self, output_spec: Dict[str, Any]):
+        self._outputs = {
+            k: np.zeros(shape, dtype) for k, (shape, dtype) in output_spec.items() if k != "hidden"
+        }
+
+    @classmethod
+    def from_model(cls, model: InferenceModel, obs) -> "RandomModel":
+        out = model.inference(obs, model.init_hidden())
+        spec = {
+            k: (v.shape, v.dtype)
+            for k, v in out.items()
+            if k != "hidden" and v is not None
+        }
+        return cls(spec)
+
+    def init_hidden(self, batch_dims=()):
+        return None
+
+    def inference(self, obs, hidden=None, **kwargs):
+        return {k: v.copy() for k, v in self._outputs.items()}

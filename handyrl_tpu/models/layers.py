@@ -1,0 +1,162 @@
+"""Reusable Flax building blocks for game nets.
+
+TPU-first notes:
+
+* Internally everything is NHWC (the layout XLA's TPU conv emitter
+  prefers); environments emit CHW features for parity with the reference,
+  so nets transpose once at the stem (``chw_to_nhwc``).
+* Torus (wrap-around) convolution is expressed with ``padding='CIRCULAR'``
+  — XLA lowers this to a single fused conv, replacing the reference's
+  manual concat-pad (handyrl/envs/kaggle/hungry_geese.py:23-35).
+* BatchNorm in the reference (e.g. envs/tictactoe.py:26) is replaced by
+  GroupNorm: batch-statistics-free, so the whole net is a pure function —
+  no mutable state threading through `lax.scan` RNN training loops, and no
+  cross-replica batch-stat sync on a mesh.  (Parity note: this changes
+  normalization statistics, not the architecture's capacity.)
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+def chw_to_nhwc(x: jnp.ndarray) -> jnp.ndarray:
+    """(..., C, H, W) -> (..., H, W, C)."""
+    return jnp.moveaxis(x, -3, -1)
+
+
+def _norm(num_channels: int) -> nn.Module:
+    groups = 8 if num_channels % 8 == 0 else 1
+    return nn.GroupNorm(num_groups=groups)
+
+
+class ConvBlock(nn.Module):
+    """3x3 conv + (optional) GroupNorm; ReLU is applied by callers."""
+
+    features: int
+    kernel: int = 3
+    use_norm: bool = True
+    circular: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        padding = "CIRCULAR" if self.circular else "SAME"
+        h = nn.Conv(
+            self.features,
+            (self.kernel, self.kernel),
+            padding=padding,
+            use_bias=not self.use_norm,
+        )(x)
+        if self.use_norm:
+            h = _norm(self.features)(h)
+        return h
+
+
+class DenseHead(nn.Module):
+    """1x1-conv feature mixer + flattening linear head.
+
+    Equivalent role to the reference's Head (envs/tictactoe.py:35-49):
+    board features -> per-action logits or scalar value.
+    """
+
+    mix_features: int
+    outputs: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.mix_features, (1, 1))(x)
+        h = nn.leaky_relu(h, 0.1)
+        h = h.reshape(*h.shape[:-3], -1)
+        return nn.Dense(self.outputs, use_bias=False)(h)
+
+
+class SpatialHead(nn.Module):
+    """conv3x3+GN+relu -> 1x1 conv -> flatten: per-cell action logits.
+
+    Role of the reference's Conv2dHead (envs/geister.py:100-112).
+    """
+
+    mix_features: int
+    output_features: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.mix_features, (3, 3), padding="SAME", use_bias=False)(x)
+        h = nn.relu(_norm(self.mix_features)(h))
+        h = nn.Conv(self.output_features, (1, 1), use_bias=False)(h)
+        # (H, W, F) -> (F, H, W) flattening so logit index = f*H*W + x*W + y,
+        # matching the reference's CHW flatten (envs/geister.py:111).
+        h = jnp.moveaxis(h, -1, -3)
+        return h.reshape(*h.shape[:-3], -1)
+
+
+class ScalarHead(nn.Module):
+    """1x1 conv+GN+relu -> flatten -> linear scalar head (envs/geister.py:115-127)."""
+
+    mix_features: int
+    outputs: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.mix_features, (1, 1), use_bias=False)(x)
+        h = nn.relu(_norm(self.mix_features)(h))
+        h = h.reshape(*h.shape[:-3], -1)
+        return nn.Dense(self.outputs, use_bias=False)(h)
+
+
+class ConvLSTMCell(nn.Module):
+    """Convolutional LSTM cell over NHWC feature maps.
+
+    State is an (h, c) tuple of (..., H, W, C) arrays.  One fused conv
+    produces all four gates (cf. reference envs/geister.py:17-57).
+    """
+
+    features: int
+    kernel: int = 3
+
+    @nn.compact
+    def __call__(self, x, state: Tuple[jnp.ndarray, jnp.ndarray]):
+        h_prev, c_prev = state
+        gates = nn.Conv(4 * self.features, (self.kernel, self.kernel), padding="SAME")(
+            jnp.concatenate([x, h_prev], axis=-1)
+        )
+        i, f, o, g = jnp.split(gates, 4, axis=-1)
+        c = nn.sigmoid(f) * c_prev + nn.sigmoid(i) * jnp.tanh(g)
+        h = nn.sigmoid(o) * jnp.tanh(c)
+        return h, (h, c)
+
+
+class DRC(nn.Module):
+    """Deep Repeated Convolutional LSTM (arXiv:1901.03559).
+
+    ``num_layers`` stacked ConvLSTM cells applied ``num_repeats`` times per
+    timestep; layer i>0 consumes layer i-1's fresh hidden state, layer 0
+    consumes the input (cf. reference envs/geister.py:65-97).
+
+    Hidden state is a pair of arrays shaped (*batch, num_layers, H, W, C):
+    batch dims lead on every pytree leaf in this framework, so hidden state
+    shards / vmaps / stacks exactly like observations.
+    """
+
+    num_layers: int
+    features: int
+    num_repeats: int = 3
+
+    @nn.compact
+    def __call__(self, x, hidden):
+        hs = [hidden[0][..., i, :, :, :] for i in range(self.num_layers)]
+        cs = [hidden[1][..., i, :, :, :] for i in range(self.num_layers)]
+        cells = [ConvLSTMCell(self.features, name=f"cell{i}") for i in range(self.num_layers)]
+        for _ in range(self.num_repeats):
+            for i, cell in enumerate(cells):
+                inp = x if i == 0 else hs[i - 1]
+                _, (hs[i], cs[i]) = cell(inp, (hs[i], cs[i]))
+        new_hidden = (jnp.stack(hs, axis=-4), jnp.stack(cs, axis=-4))
+        return hs[-1], new_hidden
+
+    def initial_state(self, batch_dims: Sequence[int], spatial: Tuple[int, int]):
+        shape = (*batch_dims, self.num_layers, *spatial, self.features)
+        return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))

@@ -1,0 +1,128 @@
+"""Game-specific policy/value networks (Flax).
+
+Every net shares one calling convention:
+
+    outputs = module.apply(variables, obs, hidden, train=False)
+
+* ``obs`` — the environment's observation pytree with a leading batch dim
+  (CHW feature planes, as emitted by envs; nets convert to NHWC).
+* ``hidden`` — recurrent state pytree or None.
+* returns a dict with 'policy' (action logits), 'value' in [-1, 1],
+  optionally 'return' (reward-sum head) and 'hidden' (next state).
+
+Recurrent nets also expose ``initial_state(batch_dims)`` which needs no
+params (pure zeros), so hosts can allocate hidden state cheaply.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from .layers import ConvBlock, DenseHead, DRC, ScalarHead, SpatialHead, chw_to_nhwc
+
+
+class SimpleConvNet(nn.Module):
+    """TicTacToe net: conv stem + 3 normed conv blocks + policy/value heads.
+
+    Capability parity with reference SimpleConv2dModel
+    (envs/tictactoe.py:52-69); norm is GroupNorm (see layers.py).
+    """
+
+    filters: int = 32
+    blocks: int = 3
+    num_actions: int = 9
+
+    @nn.compact
+    def __call__(self, obs, hidden=None, train: bool = False):
+        h = chw_to_nhwc(obs)
+        h = nn.relu(nn.Conv(self.filters, (3, 3), padding="SAME")(h))
+        for _ in range(self.blocks):
+            h = nn.relu(ConvBlock(self.filters)(h))
+        policy = DenseHead(2, self.num_actions)(h)
+        value = jnp.tanh(DenseHead(1, 1)(h))
+        return {"policy": policy, "value": value}
+
+    @nn.nowrap
+    def initial_state(self, batch_dims: Sequence[int] = ()):
+        return None
+
+
+class GeeseNet(nn.Module):
+    """HungryGeese net: torus-conv residual tower, head-cell + mean pooling.
+
+    Capability parity with reference GeeseNet
+    (envs/kaggle/hungry_geese.py:38-57): policy reads features at the own
+    head cell (obs channel 0), value reads head + board-average features.
+    Circular padding is native (layers.ConvBlock(circular=True)).
+    """
+
+    filters: int = 32
+    blocks: int = 12
+    num_actions: int = 4
+
+    @nn.compact
+    def __call__(self, obs, hidden=None, train: bool = False):
+        x = chw_to_nhwc(obs)  # (B, 7, 11, 17)
+        h = nn.relu(ConvBlock(self.filters, circular=True)(x))
+        for _ in range(self.blocks):
+            h = nn.relu(h + ConvBlock(self.filters, circular=True)(h))
+        head_mask = x[..., :1]  # own head plane
+        h_head = (h * head_mask).sum(axis=(-3, -2))
+        h_avg = h.mean(axis=(-3, -2))
+        policy = nn.Dense(self.num_actions, use_bias=False)(h_head)
+        value = jnp.tanh(nn.Dense(1, use_bias=False)(jnp.concatenate([h_head, h_avg], axis=-1)))
+        return {"policy": policy, "value": value}
+
+    @nn.nowrap
+    def initial_state(self, batch_dims: Sequence[int] = ()):
+        return None
+
+
+class GeisterNet(nn.Module):
+    """Geister net: conv stem + DRC ConvLSTM core + move/set policy,
+    value and return heads.
+
+    Capability parity with reference GeisterNet (envs/geister.py:130-166):
+    scalar features are broadcast to board planes and concatenated; the
+    'set' policy (70 layout logits) is a linear map of the turn-color bit;
+    outputs 144 move logits ++ 70 set logits.
+    """
+
+    filters: int = 32
+    drc_layers: int = 3
+    drc_repeats: int = 3
+    board_size: int = 6
+
+    def _drc(self):
+        return DRC(self.drc_layers, self.filters, self.drc_repeats, name="drc")
+
+    @nn.compact
+    def __call__(self, obs, hidden=None, train: bool = False):
+        board = chw_to_nhwc(obs["board"])        # (B, 6, 6, 7)
+        scalar = obs["scalar"]                   # (B, 18)
+        s_planes = jnp.broadcast_to(
+            scalar[..., None, None, :],
+            (*scalar.shape[:-1], self.board_size, self.board_size, scalar.shape[-1]),
+        )
+        h = jnp.concatenate([s_planes, board], axis=-1)
+        h = nn.relu(ConvBlock(self.filters)(h))
+
+        if hidden is None:
+            hidden = self.initial_state(board.shape[:-3])
+        h, new_hidden = self._drc()(h, hidden)
+
+        p_move = SpatialHead(8, 4)(h)                       # 4 * 36 = 144 logits
+        turn_color = scalar[..., 0:1]
+        p_set = nn.Dense(70)(turn_color)                    # layout logits
+        policy = jnp.concatenate([p_move, p_set], axis=-1)
+        value = jnp.tanh(ScalarHead(2, 1)(h))
+        ret = ScalarHead(2, 1, name="return_head")(h)
+        return {"policy": policy, "value": value, "return": ret, "hidden": new_hidden}
+
+    @nn.nowrap
+    def initial_state(self, batch_dims: Sequence[int] = ()):
+        shape = (*batch_dims, self.drc_layers, self.board_size, self.board_size, self.filters)
+        return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))

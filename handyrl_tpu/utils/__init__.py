@@ -1,0 +1,19 @@
+from .tree import (
+    tree_map,
+    tree_stack,
+    tree_unstack,
+    tree_index,
+    tree_zeros_like,
+    tree_concat,
+    softmax,
+)
+
+__all__ = [
+    "tree_map",
+    "tree_stack",
+    "tree_unstack",
+    "tree_index",
+    "tree_zeros_like",
+    "tree_concat",
+    "softmax",
+]

@@ -1,0 +1,64 @@
+"""Pytree helpers for nested observation / hidden-state structures.
+
+The reference implements its own recursive mappers (``map_r``/``bimap_r``/
+``trimap_r``/``rotate``, handyrl/util.py:7-63) because torch has no pytree
+story.  JAX does: everything here is a thin veneer over ``jax.tree`` so the
+same helpers work on host-side numpy structures and on traced jax arrays.
+
+``tree_stack`` replaces the reference's double-``rotate`` batching idiom
+(handyrl/train.py:77-78): instead of transposing nested python lists, we
+stack N structurally-identical pytrees leaf-wise into one pytree of
+batched arrays.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def tree_map(fn, tree, *rest):
+    """Map ``fn`` over one or more pytrees (None treated as a leaf)."""
+    return jax.tree.map(fn, tree, *rest, is_leaf=lambda x: x is None)
+
+
+def tree_stack(trees, axis=0):
+    """Stack a sequence of structurally-identical pytrees leaf-wise.
+
+    [{'a': (3,)}, {'a': (3,)}] -> {'a': (2, 3)}
+    """
+    trees = list(trees)
+    return jax.tree.map(lambda *leaves: np.stack(leaves, axis=axis), *trees)
+
+
+def tree_unstack(tree, axis=0):
+    """Inverse of tree_stack: one pytree of batched arrays -> list of pytrees."""
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return []
+    n = leaves[0].shape[axis]
+    out = []
+    for i in range(n):
+        out.append(jax.tree.unflatten(treedef, [np.take(l, i, axis=axis) for l in leaves]))
+    return out
+
+
+def tree_index(tree, idx):
+    """Index every leaf of a pytree along axis 0."""
+    return jax.tree.map(lambda x: x[idx], tree)
+
+
+def tree_zeros_like(tree):
+    return jax.tree.map(lambda x: np.zeros_like(x), tree)
+
+
+def tree_concat(trees, axis=0):
+    trees = list(trees)
+    return jax.tree.map(lambda *leaves: np.concatenate(leaves, axis=axis), *trees)
+
+
+def softmax(x):
+    """Numerically stable softmax over the last axis (numpy, host-side)."""
+    x = np.asarray(x, dtype=np.float32)
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)

@@ -1,0 +1,18 @@
+"""Test bootstrap: force an 8-device virtual CPU platform so multi-chip
+sharding paths are exercised without TPU hardware.
+
+jax may already be imported by site customizations before this runs, but
+backends initialize lazily, so ``jax.config.update`` still takes effect as
+long as no computation has run yet.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,162 @@
+"""Environment contract tests.
+
+Same three-interface strategy as the reference (tests/test_environment.py):
+property checks, full random games through the shared-env interface, and
+full games driven purely through the ``diff_info``/``update`` replica
+protocol (the socket-free surrogate for network battle mode), plus extra
+determinism/outcome invariants the reference lacks.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.envs import make_env
+
+ENV_NAMES = ["TicTacToe", "ParallelTicTacToe", "Geister", "HungryGeese"]
+
+
+def _make(name):
+    return make_env({"env": name})
+
+
+@pytest.mark.parametrize("name", ENV_NAMES)
+def test_environment_property(name):
+    e = _make(name)
+    players = e.players()
+    assert len(players) >= 2
+    str(e)
+    e.reset()
+    for p in e.turns():
+        acts = e.legal_actions(p)
+        assert len(acts) > 0
+        # codecs round-trip
+        for a in acts[:5]:
+            assert e.str2action(e.action2str(a, p), p) == a
+
+
+@pytest.mark.parametrize("name", ENV_NAMES)
+def test_environment_local(name):
+    random.seed(0)
+    e = _make(name)
+    for _ in range(100):
+        e.reset()
+        steps = 0
+        while not e.terminal():
+            actions = {p: random.choice(e.legal_actions(p)) for p in e.turns()}
+            e.step(actions)
+            e.reward()
+            steps += 1
+            assert steps < 1000, "game failed to terminate"
+        outcome = e.outcome()
+        assert set(outcome.keys()) == set(e.players())
+        # zero-sum style outcomes
+        assert abs(sum(outcome.values())) < 1e-6
+
+
+@pytest.mark.parametrize("name", ENV_NAMES)
+def test_environment_network(name):
+    """Replica envs driven only by diff_info/update stay action-consistent."""
+    random.seed(1)
+    e = _make(name)
+    replicas = {p: _make(name) for p in e.players()}
+    for _ in range(100):
+        e.reset()
+        for p, rep in replicas.items():
+            rep.update(e.diff_info(p), True)
+        while not e.terminal():
+            actions = {}
+            for p in e.turns():
+                assert set(e.legal_actions(p)) == set(replicas[p].legal_actions(p))
+                # a replica must see exactly what the master would show it
+                np.testing.assert_equal(replicas[p].observation(p), e.observation(p))
+                a = random.choice(replicas[p].legal_actions(p))
+                actions[p] = e.str2action(replicas[p].action2str(a, p), p)
+            e.step(actions)
+            for p, rep in replicas.items():
+                rep.update(e.diff_info(p), False)
+                # replicas must agree the game is (not) over
+                assert rep.terminal() == e.terminal()
+            e.reward()
+        e.outcome()
+
+
+@pytest.mark.parametrize("name", ENV_NAMES)
+def test_observation_shape_stable(name):
+    """Observations keep identical pytree structure/shape/dtype every step —
+    a hard requirement for fixed-shape XLA batching."""
+    import jax
+
+    random.seed(2)
+    e = _make(name)
+    e.reset()
+    ref_struct = jax.tree.map(lambda x: (x.shape, x.dtype), e.observation(e.players()[0]))
+    for _ in range(3):
+        e.reset()
+        while not e.terminal():
+            for p in e.players():
+                struct = jax.tree.map(lambda x: (x.shape, x.dtype), e.observation(p))
+                assert struct == ref_struct
+            e.step({p: random.choice(e.legal_actions(p)) for p in e.turns()})
+
+
+def test_tictactoe_known_positions():
+    e = _make("TicTacToe")
+    e.reset()
+    # O plays 0,1,2 (top row) while X plays 3,4: O wins
+    for a in [0, 3, 1, 4, 2]:
+        e.play(a)
+    assert e.terminal()
+    assert e.outcome() == {0: 1, 1: -1}
+    # X wins the middle column: O plays 0,2,6 / X plays 1,4,7
+    e.reset()
+    for a in [0, 1, 2, 4, 6, 7]:
+        e.play(a)
+    assert e.terminal()
+    assert e.outcome() == {0: -1, 1: 1}
+    # full-board draw: 0,1,2,4,3,5,7,6,8 alternating
+    e.reset()
+    for a in [0, 1, 2, 4, 3, 5, 7, 6, 8]:
+        e.play(a)
+    assert e.terminal()
+    assert e.outcome() == {0: 0, 1: 0}
+
+
+def test_geister_piece_accounting():
+    random.seed(3)
+    e = _make("Geister")
+    for _ in range(20):
+        e.reset()
+        while not e.terminal():
+            e.play(random.choice(e.legal_actions()))
+            counts = e._piece_counts()
+            total = sum(counts[0]) + sum(counts[1])
+            assert total == int(e.alive.sum()) <= 16
+        assert e.win_color in (0, 1, 2)
+
+
+def test_hungry_geese_ranking():
+    e = _make("HungryGeese")
+    e.reset()
+    e.rank_rewards = [400, 400, 300, 100]
+    out = e.outcome()
+    assert out[0] == out[1] > out[2] > out[3]
+    assert abs(sum(out.values())) < 1e-9
+
+
+def test_observation_viewpoint_rotation():
+    """Geister: White's observation is the 180-rotation of the board."""
+    random.seed(4)
+    e = _make("Geister")
+    e.reset()
+    e.play(144)  # black layout 0
+    e.play(144)  # white layout 0
+    obs_b = e.observation(0)
+    obs_w = e.observation(1)
+    assert obs_b["board"].shape == (7, 6, 6)
+    assert obs_w["board"].shape == (7, 6, 6)
+    # plane 1 is "my pieces": white's own pieces rotated must equal black's view of white pieces
+    np.testing.assert_allclose(
+        np.rot90(obs_w["board"][1], k=2, axes=(0, 1)), obs_b["board"][2]
+    )

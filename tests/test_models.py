@@ -1,0 +1,81 @@
+"""Model family tests: shapes, recurrence, determinism, batching."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from handyrl_tpu.envs import make_env
+from handyrl_tpu.models import InferenceModel, RandomModel, init_variables
+
+
+def _build(name):
+    env = make_env({"env": name})
+    module = env.net()
+    variables = init_variables(module, env, seed=0)
+    return env, module, InferenceModel(module, variables)
+
+
+@pytest.mark.parametrize("name,num_actions", [("TicTacToe", 9), ("HungryGeese", 4)])
+def test_feedforward_nets(name, num_actions):
+    env, module, model = _build(name)
+    env.reset()
+    obs = env.observation(env.players()[0])
+    out = model.inference(obs, model.init_hidden())
+    assert out["policy"].shape == (num_actions,)
+    assert out["value"].shape == (1,)
+    assert -1 <= float(out["value"][0]) <= 1
+    # batched path agrees with single path
+    obs_b = jax.tree.map(lambda x: np.stack([x, x]), obs)
+    out_b = model.inference_batch(obs_b)
+    np.testing.assert_allclose(out_b["policy"][0], out_b["policy"][1], atol=1e-5)
+    np.testing.assert_allclose(out_b["policy"][0], out["policy"], atol=1e-5)
+
+
+def test_geister_recurrent_net():
+    env, module, model = _build("Geister")
+    env.reset()
+    env.play(144)
+    env.play(150)
+    obs = env.observation(0)
+    hidden = model.init_hidden()
+    assert hidden is not None
+    out = model.inference(obs, hidden)
+    assert out["policy"].shape == (214,)
+    assert out["value"].shape == (1,)
+    assert out["return"].shape == (1,)
+    # hidden state evolves and feeds back
+    h1 = out["hidden"]
+    assert not np.allclose(h1[0], hidden[0])
+    out2 = model.inference(obs, h1)
+    assert not np.allclose(out2["value"], out["value"]) or not np.allclose(
+        out2["hidden"][0], h1[0]
+    )
+
+
+def test_geister_hidden_batch_leading():
+    env, module, model = _build("Geister")
+    hidden = model.init_hidden((5, 2))
+    assert hidden[0].shape == (5, 2, 3, 6, 6, 32)
+
+
+def test_random_model():
+    env, module, model = _build("TicTacToe")
+    env.reset()
+    obs = env.observation(0)
+    rm = RandomModel.from_model(model, obs)
+    out = rm.inference(obs)
+    assert np.all(out["policy"] == 0)
+    assert np.all(out["value"] == 0)
+
+
+def test_jit_cache_no_recompile():
+    """Repeated same-shape inference hits the jit cache (one compile)."""
+    env, module, model = _build("TicTacToe")
+    env.reset()
+    obs = env.observation(0)
+    model.inference(obs)
+    compiled_before = model._apply._cache_size()
+    for _ in range(5):
+        model.inference(obs)
+    assert model._apply._cache_size() == compiled_before
