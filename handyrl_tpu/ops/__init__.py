@@ -1,0 +1,4 @@
+from .targets import compute_target
+from .losses import compute_loss_from_outputs
+
+__all__ = ["compute_target", "compute_loss_from_outputs"]

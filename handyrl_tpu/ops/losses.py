@@ -1,0 +1,115 @@
+"""Policy-gradient loss core (pure function of net outputs + batch).
+
+Semantics parity with reference handyrl/train.py:190-268 (compute_loss /
+compose_losses): clipped importance sampling (rho/c capped at 1), optional
+two-player zero-sum value symmetrization, outcome bootstrap beyond episode
+end, separate policy/value target algorithms, entropy regularization with
+progress-based decay.
+
+Everything here is jax-traceable and shape-static: it runs inside the one
+jitted training step (parallel/train_step.py).  Model-dependent forward
+prediction is NOT here — this consumes its outputs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .targets import compute_target
+
+
+def _huber(x, delta: float = 1.0):
+    """Smooth-L1 (torch F.smooth_l1_loss semantics, beta=1)."""
+    absx = jnp.abs(x)
+    return jnp.where(absx < delta, 0.5 * x * x / delta, absx - 0.5 * delta)
+
+
+def entropy_from_logits(logits):
+    """Categorical entropy over the last axis; safe with -1e32 legal masks."""
+    ls = jax.nn.log_softmax(logits, axis=-1)
+    p = jnp.exp(ls)
+    return -(p * ls).sum(axis=-1)
+
+
+def compute_loss_from_outputs(
+    outputs: Dict[str, jnp.ndarray],
+    batch: Dict[str, Any],
+    args: Dict[str, Any],
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Compute losses given already-trimmed outputs/batch (burn-in removed).
+
+    outputs['policy'] must already be turn-masked and legal-action-masked
+    (see parallel/train_step.forward_prediction).
+
+    Returns (losses dict incl. 'total', data count = turn mask sum).
+    """
+    actions = batch["action"]          # (B, T, P, 1) int32
+    emasks = batch["episode_mask"]     # (B, T, 1, 1)
+    tmasks = batch["turn_mask"]        # (B, T, P, 1)
+    omasks = batch["observation_mask"]  # (B, T, P, 1)
+
+    clip_rho, clip_c = 1.0, 1.0
+
+    log_behavior = jnp.log(jnp.clip(batch["selected_prob"], 1e-16, 1.0)) * emasks
+    log_pi = jax.nn.log_softmax(outputs["policy"], axis=-1)
+    log_target = jnp.take_along_axis(log_pi, actions, axis=-1) * emasks
+
+    log_rhos = jax.lax.stop_gradient(log_target) - log_behavior
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.clip(rhos, 0.0, clip_rho)
+    cs = jnp.clip(rhos, 0.0, clip_c)
+
+    outputs_nograd = {k: jax.lax.stop_gradient(v) for k, v in outputs.items()}
+    value_target_masks = omasks
+
+    if "value" in outputs_nograd:
+        values_nograd = outputs_nograd["value"]
+        if args["turn_based_training"] and values_nograd.shape[2] == 2:
+            # Two-player zero-sum: each player's value estimate is averaged
+            # with the negation of the opponent's (train.py:244-248).
+            values_opp = -jnp.flip(values_nograd, axis=2)
+            omasks_opp = jnp.flip(omasks, axis=2)
+            values_nograd = (values_nograd * omasks + values_opp * omasks_opp) / (
+                omasks + omasks_opp + 1e-8
+            )
+            value_target_masks = jnp.clip(omasks + omasks_opp, 0.0, 1.0)
+        # Beyond episode end the target value is the final outcome.
+        outputs_nograd["value"] = values_nograd * emasks + batch["outcome"] * (1 - emasks)
+
+    lmb, gamma = args["lambda"], args["gamma"]
+    value_args = (outputs_nograd.get("value"), batch["outcome"], None, lmb, 1.0, clipped_rhos, cs, value_target_masks)
+    return_args = (outputs_nograd.get("return"), batch["return"], batch["reward"], lmb, gamma, clipped_rhos, cs, omasks)
+
+    targets, advantages = {}, {}
+    targets["value"], advantages["value"] = compute_target(args["value_target"], *value_args)
+    targets["return"], advantages["return"] = compute_target(args["value_target"], *return_args)
+    if args["policy_target"] != args["value_target"]:
+        _, advantages["value"] = compute_target(args["policy_target"], *value_args)
+        _, advantages["return"] = compute_target(args["policy_target"], *return_args)
+
+    total_advantages = clipped_rhos * (advantages["value"] + advantages["return"])
+
+    # -- compose (train.py:190-216) ---------------------------------------
+    losses: Dict[str, jnp.ndarray] = {}
+    dcnt = tmasks.sum()
+
+    losses["p"] = (-log_target * jax.lax.stop_gradient(total_advantages) * tmasks).sum()
+    if "value" in outputs:
+        losses["v"] = (((outputs["value"] - targets["value"]) ** 2) * omasks).sum() / 2
+    if "return" in outputs:
+        losses["r"] = (_huber(outputs["return"] - targets["return"]) * omasks).sum()
+
+    entropy = entropy_from_logits(outputs["policy"]) * tmasks.sum(axis=-1)  # (B, T, P)
+    losses["ent"] = entropy.sum()
+
+    # progress is (B, T, 1): broadcasts over the player axis of entropy.
+    progress_decay = 1 - batch["progress"] * (1 - args["entropy_regularization_decay"])
+    entropy_loss = (entropy * progress_decay).sum() * -args["entropy_regularization"]
+
+    base = losses["p"] + losses.get("v", 0.0) + losses.get("r", 0.0)
+    losses["total"] = base + entropy_loss
+
+    return losses, dcnt

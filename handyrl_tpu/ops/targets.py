@@ -1,0 +1,134 @@
+"""Off-policy return/advantage targets as time-reversed ``lax.scan``s.
+
+Semantics parity with reference handyrl/losses.py:16-81 (Monte Carlo,
+TD(lambda), UPGO, V-Trace per arXiv:1802.01561), re-expressed for XLA:
+the reference's per-timestep python deque recursions become single
+``lax.scan``s over the time axis, so the whole target computation compiles
+into the training step (no host loop, fuses with the loss).
+
+Shape convention: all tensors are (B, T, P, C) — batch, time, player,
+channel.  ``lambda_`` follows the reference's mask dispatch
+(losses.py:71): lambda_ = lmb + (1 - lmb) * (1 - mask), i.e. unobserved
+steps propagate the bootstrap straight through (lambda = 1).
+
+The final-step bootstrap is ``returns[:, -1]`` — for the 'value' channel
+callers pass the episode outcome as ``returns``, for the 'return' channel
+the discounted reward sum (see ops/losses.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _time_leading(x):
+    return jnp.moveaxis(x, 1, 0)  # (B, T, ...) -> (T, B, ...)
+
+
+def _batch_leading(x):
+    return jnp.moveaxis(x, 0, 1)
+
+
+def _reverse_scan(step_fn, bootstrap, xs_time_leading):
+    """Run ``step_fn`` backwards over time, returning (T, ...) outputs
+    where index i holds the carry computed at step i (and the last index
+    holds ``bootstrap``)."""
+    _, ys = jax.lax.scan(step_fn, bootstrap, xs_time_leading, reverse=True)
+    return ys
+
+
+def monte_carlo(values, returns):
+    return returns, returns - values
+
+
+def td_lambda(values, returns, rewards, lambda_, gamma):
+    """TD(lambda) targets (reference losses.py:20-29)."""
+    bootstrap = returns[:, -1]
+    v_next = _time_leading(values[:, 1:])
+    lam_next = _time_leading(lambda_[:, 1:])
+    r_cur = _time_leading(rewards[:, :-1]) if rewards is not None else jnp.zeros_like(v_next)
+
+    def step(carry, x):
+        v1, lam, r = x
+        tv = r + gamma * ((1 - lam) * v1 + lam * carry)
+        return tv, tv
+
+    ys = _reverse_scan(step, bootstrap, (v_next, lam_next, r_cur))
+    targets = jnp.concatenate([_batch_leading(ys), bootstrap[:, None]], axis=1)
+    return targets, targets - values
+
+
+def upgo(values, returns, rewards, lambda_, gamma):
+    """UPGO targets: bootstrap from max(V, lambda-mixture) (losses.py:32-42)."""
+    bootstrap = returns[:, -1]
+    v_next = _time_leading(values[:, 1:])
+    lam_next = _time_leading(lambda_[:, 1:])
+    r_cur = _time_leading(rewards[:, :-1]) if rewards is not None else jnp.zeros_like(v_next)
+
+    def step(carry, x):
+        v1, lam, r = x
+        tv = r + gamma * jnp.maximum(v1, (1 - lam) * v1 + lam * carry)
+        return tv, tv
+
+    ys = _reverse_scan(step, bootstrap, (v_next, lam_next, r_cur))
+    targets = jnp.concatenate([_batch_leading(ys), bootstrap[:, None]], axis=1)
+    return targets, targets - values
+
+
+def vtrace(values, returns, rewards, lambda_, gamma, rhos, cs):
+    """V-Trace targets and advantages (losses.py:45-60, arXiv:1802.01561)."""
+    r = rewards if rewards is not None else jnp.zeros_like(values)
+    bootstrap = returns[:, -1:]
+    v_next = jnp.concatenate([values[:, 1:], bootstrap], axis=1)
+    deltas = rhos * (r + gamma * v_next - values)
+
+    d = _time_leading(deltas[:, :-1])
+    lam_next = _time_leading(lambda_[:, 1:])
+    c_cur = _time_leading(cs[:, :-1])
+
+    def step(carry, x):
+        delta, lam, c = x
+        acc = delta + gamma * lam * c * carry
+        return acc, acc
+
+    ys = _reverse_scan(step, deltas[:, -1], (d, lam_next, c_cur))
+    vs_minus_v = jnp.concatenate([_batch_leading(ys), deltas[:, -1:]], axis=1)
+    vs = vs_minus_v + values
+    vs_next = jnp.concatenate([vs[:, 1:], bootstrap], axis=1)
+    advantages = r + gamma * vs_next - values
+    return vs, advantages
+
+
+def compute_target(
+    algorithm: str,
+    values: Optional[jnp.ndarray],
+    returns: jnp.ndarray,
+    rewards: Optional[jnp.ndarray],
+    lmb: float,
+    gamma: float,
+    rhos: jnp.ndarray,
+    cs: jnp.ndarray,
+    masks: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dispatch matching reference losses.py:63-81.
+
+    ``algorithm`` is a static (trace-time) string: MC / TD / UPGO / VTRACE.
+    Without a value baseline, Monte Carlo returns are target and advantage.
+    """
+    if values is None:
+        return returns, returns
+    if algorithm == "MC":
+        return monte_carlo(values, returns)
+
+    lambda_ = lmb + (1 - lmb) * (1 - masks)
+
+    if algorithm == "TD":
+        return td_lambda(values, returns, rewards, lambda_, gamma)
+    if algorithm == "UPGO":
+        return upgo(values, returns, rewards, lambda_, gamma)
+    if algorithm == "VTRACE":
+        return vtrace(values, returns, rewards, lambda_, gamma, rhos, cs)
+    raise ValueError(f"unknown target algorithm {algorithm!r}")

@@ -1,0 +1,4 @@
+from .mesh import make_mesh, batch_sharding, replicated_sharding
+from .train_step import TrainContext, forward_prediction
+
+__all__ = ["make_mesh", "batch_sharding", "replicated_sharding", "TrainContext", "forward_prediction"]

@@ -1,0 +1,204 @@
+"""The jitted, sharded training step — forward, targets, loss, optimizer.
+
+This is the TPU replacement for the reference's host-side training loop
+(train.py:128-268, 348-372): ONE compiled function per batch shape doing
+
+    forward (FF flatten or lax.scan RNN with burn-in)
+    -> loss core (ops/losses.py, targets as reverse scans)
+    -> global-norm clip + L2 decay + Adam
+    -> parameter update
+
+under ``jax.jit`` with NamedShardings: the batch is sharded over the 'dp'
+mesh axis, params/optimizer state replicated; XLA inserts the gradient
+all-reduce over ICI.  The learning rate is a scalar argument (the
+reference's data-count-EMA schedule, train.py:328-332/383-385, is computed
+on host per epoch).
+
+Forward-prediction semantics parity (train.py:128-187):
+* feed-forward nets flatten (B, T, P) into one device batch;
+* recurrent nets scan over T carrying hidden state, zeroing the carry into
+  steps a player did not observe and only committing new hidden where
+  observed; burn-in steps run under stop_gradient;
+* policy logits are turn-masked (summed over the player axis for
+  turn-alternating batches) and get the action mask subtracted;
+* value-ish outputs are observation-masked (broadcasting the turn player's
+  prediction against the full-player mask in turn-based mode).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops import compute_loss_from_outputs
+from ..utils import tree_map
+from .mesh import batch_sharding, replicated_sharding
+
+
+def _flat_apply(module, params, obs, lead_shape):
+    """Apply module to observations flattened over ``lead_shape`` dims."""
+    n = len(lead_shape)
+    flat = tree_map(lambda x: x.reshape((-1,) + x.shape[n:]), obs)
+    out = module.apply({"params": params}, flat, None)
+    return {
+        k: v.reshape(lead_shape + v.shape[1:])
+        for k, v in out.items()
+        if k != "hidden" and v is not None
+    }
+
+
+def forward_prediction(module, params, batch: Dict[str, Any], args: Dict[str, Any]) -> Dict[str, Any]:
+    """Run the net over a (B, T, P, ...) batch; returns post-burn-in outputs
+    of length forward_steps, already turn/action/observation masked."""
+    obs = batch["observation"]
+    B, T, P1 = batch["action"].shape[:3]
+    burn_in = args["burn_in_steps"]
+    hidden0 = module.initial_state((B, P1))
+
+    if hidden0 is None:
+        outputs = _flat_apply(module, params, obs, (B, T, P1))
+        outputs = {k: v[:, burn_in:] for k, v in outputs.items()}
+    else:
+        omask = batch["observation_mask"]
+        assert omask.shape[2] == P1, (
+            "recurrent training requires full-player batches "
+            "(set observation: true for RNN models)"
+        )
+        obs_tl = tree_map(lambda x: jnp.moveaxis(x, 1, 0), obs)      # (T, B, P, ...)
+        omask_tl = jnp.moveaxis(omask, 1, 0)                          # (T, B, P, 1)
+
+        def step(hidden, x):
+            obs_t, omask_t = x
+
+            def mask_like(h):
+                m = omask_t.reshape(omask_t.shape[:2] + (1,) * (h.ndim - 2))
+                return m
+
+            h_in = tree_map(lambda h: h * mask_like(h), hidden)
+            h_flat = tree_map(lambda h: h.reshape((-1,) + h.shape[2:]), h_in)
+            obs_flat = tree_map(lambda o: o.reshape((-1,) + o.shape[2:]), obs_t)
+            out = module.apply({"params": params}, obs_flat, h_flat)
+            new_hidden = tree_map(
+                lambda h: h.reshape((B, P1) + h.shape[1:]), out.pop("hidden")
+            )
+            # commit new hidden only where observed (train.py:174)
+            hidden = jax.tree.map(
+                lambda h, nh: h * (1 - mask_like(h)) + nh * mask_like(nh), hidden, new_hidden
+            )
+            outs = {
+                k: v.reshape((B, P1) + v.shape[1:]) for k, v in out.items() if v is not None
+            }
+            return hidden, outs
+
+        def burn_step(hidden, x):
+            hidden, _ = step(hidden, x)
+            return jax.lax.stop_gradient(hidden), None
+
+        slice_t = lambda tree, lo, hi: tree_map(lambda x: x[lo:hi], tree)
+        hidden = hidden0
+        if burn_in > 0:
+            hidden, _ = jax.lax.scan(
+                burn_step, hidden, (slice_t(obs_tl, 0, burn_in), omask_tl[:burn_in])
+            )
+        _, outs_tl = jax.lax.scan(
+            step, hidden, (slice_t(obs_tl, burn_in, T), omask_tl[burn_in:])
+        )
+        outputs = {k: jnp.moveaxis(v, 0, 1) for k, v in outs_tl.items()}  # (B, T', P, ...)
+
+    # -- output masking (train.py:177-187), on post-burn-in arrays ---------
+    tmask = batch["turn_mask"][:, burn_in:]
+    omask = batch["observation_mask"][:, burn_in:]
+    amask = batch["action_mask"][:, burn_in:]
+
+    masked = {}
+    for k, v in outputs.items():
+        if k == "policy":
+            v = v * tmask
+            if v.shape[2] > 1 and P1 == 1:
+                v = v.sum(axis=2, keepdims=True)  # gather the turn player's logits
+            masked[k] = v - amask
+        else:
+            masked[k] = v * omask
+    return masked
+
+
+def trim_burn_in(batch: Dict[str, Any], burn_in: int) -> Dict[str, Any]:
+    """Drop burn-in steps from every time-majored batch array (train.py:222)."""
+    if burn_in == 0:
+        return batch
+    return {k: (v[:, burn_in:] if v.shape[1] > 1 else v) for k, v in batch.items() if k != "observation"} | {
+        "observation": tree_map(lambda x: x[:, burn_in:], batch["observation"])
+    }
+
+
+def make_optimizer() -> optax.GradientTransformation:
+    """clip(4.0) -> L2 weight decay 1e-5 -> Adam, matching reference
+    train.py:328-332 + 371 (decay applied to gradients, torch-Adam style).
+    The learning rate is applied separately in the train step."""
+    return optax.chain(
+        optax.clip_by_global_norm(4.0),
+        optax.add_decayed_weights(1e-5),
+        optax.scale_by_adam(),
+    )
+
+
+class TrainContext:
+    """Owns the mesh, the optimizer, and the compiled train step."""
+
+    def __init__(self, module, args: Dict[str, Any], mesh):
+        self.module = module
+        self.args = args
+        self.mesh = mesh
+        self.tx = make_optimizer()
+        self._replicated = replicated_sharding(mesh)
+        self._batch_shard = batch_sharding(mesh)
+
+        loss_keys = ("p", "v", "r", "ent", "total")
+
+        def _loss_fn(params, batch):
+            outputs = forward_prediction(self.module, params, batch, self.args)
+            trimmed = trim_burn_in(batch, self.args["burn_in_steps"])
+            losses, dcnt = compute_loss_from_outputs(outputs, trimmed, self.args)
+            full = {k: losses.get(k, jnp.zeros(())) for k in loss_keys}
+            return losses["total"], (full, dcnt)
+
+        def _step(state, batch, lr):
+            (loss, (losses, dcnt)), grads = jax.value_and_grad(_loss_fn, has_aux=True)(
+                state["params"], batch
+            )
+            updates, opt_state = self.tx.update(grads, state["opt_state"], state["params"])
+            updates = jax.tree.map(lambda u: -lr * u, updates)
+            params = optax.apply_updates(state["params"], updates)
+            new_state = {"params": params, "opt_state": opt_state, "steps": state["steps"] + 1}
+            metrics = dict(losses)
+            metrics["dcnt"] = dcnt
+            return new_state, metrics
+
+        self._train_step = jax.jit(
+            _step,
+            in_shardings=(self._replicated, self._batch_shard, None),
+            out_shardings=(self._replicated, self._replicated),
+            donate_argnums=(0,),
+        )
+
+    def init_state(self, params) -> Dict[str, Any]:
+        state = {
+            "params": params,
+            "opt_state": self.tx.init(params),
+            "steps": jnp.zeros((), jnp.int32),
+        }
+        return jax.device_put(state, self._replicated)
+
+    def put_batch(self, batch: Dict[str, Any]):
+        B = batch["action"].shape[0]
+        dp = self.mesh.shape.get("dp", 1)
+        if B % dp != 0:
+            raise ValueError(f"batch size {B} not divisible by dp axis {dp}")
+        return jax.device_put(batch, self._batch_shard)
+
+    def train_step(self, state, device_batch, lr: float):
+        return self._train_step(state, device_batch, jnp.float32(lr))

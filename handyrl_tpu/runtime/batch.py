@@ -1,0 +1,143 @@
+"""Fixed-shape training batch assembly from sampled episode windows.
+
+Mask/padding semantics parity with reference make_batch (train.py:33-125):
+
+* Base shape is (B, T, P, ...), T always exactly ``burn_in_steps +
+  forward_steps`` (XLA needs static shapes; the reference only pads short
+  windows, we always emit the same shape).
+* In turn-based training without observers, the *actor-side* arrays
+  (observation / selected_prob / action / action_mask) carry only the
+  turn player (P dim = 1) gathered per step, while *target-side* arrays
+  (value / reward / return / masks / outcome) keep every player — the
+  turn player's prediction is later broadcast against the full-player
+  turn mask (see parallel/train_step.py and train.py:177-186).
+* Padding: before the window (burn-in underflow) everything is zero;
+  after episode end values become the final outcome, selected_prob 1,
+  action_mask all-illegal (1e32), progress 1, episode_mask 0.
+
+Episode columnar format (produced by runtime/generation.py):
+  blocks[k] decompresses to a dict of arrays over t timesteps:
+    obs    pytree, leaves (t, P, ...)
+    prob   (t, P)   behavior probability of the selected action (1 if none)
+    action (t, P)   int32
+    amask  (t, P, A) 0 = legal / 1e32 = illegal (all-1e32 when not acting)
+    value  (t, P)   critic estimate at acting time (0 when unobserved)
+    reward (t, P)   immediate reward after the step
+    ret    (t, P)   discounted return-to-go
+    tmask  (t, P)   1 if the player acted this step
+    omask  (t, P)   1 if the player observed this step
+    turn   (t,)     index (into players) of the first turn player
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..utils import tree_concat, tree_map, tree_stack
+from .replay import decompress_block
+
+
+def _concat_columns(blocks: List[Dict[str, Any]]) -> Dict[str, Any]:
+    if len(blocks) == 1:
+        return blocks[0]
+    out = {
+        key: np.concatenate([b[key] for b in blocks], axis=0)
+        for key in blocks[0]
+        if key != "obs"
+    }
+    out["obs"] = tree_concat([b["obs"] for b in blocks])
+    return out
+
+
+def _assemble_one(window: Dict[str, Any], args: Dict[str, Any]) -> Dict[str, Any]:
+    cols = _concat_columns([decompress_block(b) for b in window["blocks"]])
+    lo = window["start"] - window["base"]
+    hi = window["end"] - window["base"]
+    sl = slice(lo, hi)
+
+    turn_based = args["turn_based_training"]
+    num_players = cols["prob"].shape[1]
+    if turn_based:
+        target_players = list(range(num_players))
+    else:
+        target_players = [random.randrange(num_players)]
+
+    obs = tree_map(lambda x: x[sl], cols["obs"])
+    prob = cols["prob"][sl]
+    action = cols["action"][sl]
+    amask = cols["amask"][sl]
+
+    if turn_based and not args["observation"]:
+        # Actor-side arrays: gather the turn player per step -> P dim 1.
+        turn = cols["turn"][sl]
+        t_idx = np.arange(len(turn))
+        obs = tree_map(lambda x: x[t_idx, turn][:, None], obs)
+        prob = prob[t_idx, turn][:, None]
+        action = action[t_idx, turn][:, None]
+        amask = amask[t_idx, turn][:, None]
+    else:
+        obs = tree_map(lambda x: x[:, target_players], obs)
+        prob = prob[:, target_players]
+        action = action[:, target_players]
+        amask = amask[:, target_players]
+
+    value = cols["value"][sl][:, target_players, None]
+    reward = cols["reward"][sl][:, target_players, None]
+    ret = cols["ret"][sl][:, target_players, None]
+    tmask = cols["tmask"][sl][:, target_players, None].astype(np.float32)
+    omask = cols["omask"][sl][:, target_players, None].astype(np.float32)
+    outcome = np.asarray(window["outcome"], dtype=np.float32)[target_players].reshape(1, -1, 1)
+
+    steps = hi - lo
+    emask = np.ones((steps, 1, 1), dtype=np.float32)
+    progress = (np.arange(window["start"], window["end"], dtype=np.float32) / window["total"])[:, None]
+
+    prob = prob[..., None]
+    action = action[..., None].astype(np.int32)
+
+    batch_steps = args["burn_in_steps"] + args["forward_steps"]
+    if steps < batch_steps:
+        pad_b = args["burn_in_steps"] - (window["train_start"] - window["start"])
+        pad_a = batch_steps - steps - pad_b
+
+        def pad(x, value=0.0):
+            width = [(pad_b, pad_a)] + [(0, 0)] * (x.ndim - 1)
+            return np.pad(x, width, constant_values=value)
+
+        obs = tree_map(pad, obs)
+        prob = pad(prob, 1.0)
+        action = pad(action, 0)
+        amask = pad(amask, 1e32)
+        # value: zero before the window, frozen at the outcome after the end
+        value = np.concatenate(
+            [np.pad(value, [(pad_b, 0), (0, 0), (0, 0)]), np.tile(outcome, (pad_a, 1, 1))]
+        )
+        reward = pad(reward)
+        ret = pad(ret)
+        tmask = pad(tmask)
+        omask = pad(omask)
+        emask = pad(emask)
+        progress = pad(progress, 1.0)
+
+    return {
+        "observation": obs,
+        "selected_prob": prob.astype(np.float32),
+        "value": value.astype(np.float32),
+        "action": action,
+        "outcome": outcome,
+        "reward": reward.astype(np.float32),
+        "return": ret.astype(np.float32),
+        "episode_mask": emask,
+        "turn_mask": tmask,
+        "observation_mask": omask,
+        "action_mask": amask.astype(np.float32),
+        "progress": progress.astype(np.float32),
+    }
+
+
+def make_batch(windows: List[Dict[str, Any]], args: Dict[str, Any]) -> Dict[str, Any]:
+    """Assemble B sampled windows into one (B, T, P, ...) numpy batch."""
+    return tree_stack([_assemble_one(w, args) for w in windows])

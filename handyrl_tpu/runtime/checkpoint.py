@@ -1,0 +1,60 @@
+"""Checkpointing: epoch-indexed model snapshots + full training state.
+
+Improves on the reference (train.py:448-455, which saves only the model
+state_dict): the full checkpoint carries params, optimizer state and step
+count so resume continues Adam moments instead of restarting them.
+Format is flax msgpack (framework-portable numpy trees).
+
+Layout mirrors the reference naming so tooling ports over:
+    models/{epoch}.ckpt    per-epoch params snapshot (servable to workers)
+    models/latest.ckpt     copy of the newest snapshot
+    models/state.ckpt      params + opt_state + steps (resume)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def save_params(path: str, params: Any) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(jax.device_get(params)))
+
+
+def load_params(path: str, template: Any) -> Any:
+    with open(path, "rb") as f:
+        return serialization.from_bytes(template, f.read())
+
+
+def params_to_bytes(params: Any) -> bytes:
+    return serialization.to_bytes(jax.device_get(params))
+
+
+def params_from_bytes(template: Any, blob: bytes) -> Any:
+    return serialization.from_bytes(template, blob)
+
+
+def save_train_state(path: str, state: Dict[str, Any]) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    host = jax.device_get(state)
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(host))
+
+
+def load_train_state(path: str, template: Dict[str, Any]) -> Dict[str, Any]:
+    with open(path, "rb") as f:
+        return serialization.from_bytes(template, f.read())
+
+
+def model_path(model_dir: str, epoch: int) -> str:
+    return os.path.join(model_dir, f"{epoch}.ckpt")
+
+
+def latest_model_path(model_dir: str) -> str:
+    return os.path.join(model_dir, "latest.ckpt")

@@ -1,0 +1,101 @@
+"""Episode store: compressed columnar trajectories + recency-biased sampling.
+
+Design vs the reference (train.py:271-319, generation.py:84-91):
+
+* Episodes are **columnar**: per-episode numpy arrays (T, P, ...) instead
+  of per-step python dicts.  Batch assembly is then pure array slicing —
+  no python loop over timesteps — which is what keeps the TPU learner fed.
+* Blocks of ``compress_steps`` timesteps are zlib-compressed so sampling a
+  training window only decompresses the blocks it touches (same trick as
+  the reference's bz2 chunks, faster codec).
+* Same recency-biased sampling: index i of an N-episode buffer is
+  accepted with probability 1 - (N-1-i)/N (train.py:292-303), and windows
+  of ``forward_steps`` start uniformly, extended backwards by
+  ``burn_in_steps`` when possible.
+"""
+
+from __future__ import annotations
+
+import pickle
+import random
+import threading
+import zlib
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+try:
+    import psutil
+except ImportError:  # pragma: no cover
+    psutil = None
+
+
+def compress_block(columns: Dict[str, Any]) -> bytes:
+    return zlib.compress(pickle.dumps(columns, protocol=pickle.HIGHEST_PROTOCOL), level=1)
+
+
+def decompress_block(blob: bytes) -> Dict[str, Any]:
+    return pickle.loads(zlib.decompress(blob))
+
+
+class EpisodeStore:
+    """Thread-safe bounded episode buffer with recency-biased sampling."""
+
+    def __init__(self, maximum_episodes: int):
+        self.maximum_episodes = maximum_episodes
+        self._episodes: deque = deque()
+        self._lock = threading.Lock()
+        self.total_added = 0
+
+    def __len__(self) -> int:
+        return len(self._episodes)
+
+    def extend(self, episodes: List[Dict[str, Any]]) -> None:
+        episodes = [e for e in episodes if e is not None]
+        with self._lock:
+            self._episodes.extend(episodes)
+            self.total_added += len(episodes)
+            limit = self._memory_limited_max()
+            while len(self._episodes) > limit:
+                self._episodes.popleft()
+
+    def _memory_limited_max(self) -> int:
+        """Shrink the buffer under memory pressure (reference train.py:474-483)."""
+        if psutil is not None:
+            mem_percent = psutil.virtual_memory().percent
+            if mem_percent > 95:
+                return max(1, int(len(self._episodes) * 95 / mem_percent))
+        return self.maximum_episodes
+
+    def sample_window(self, forward_steps: int, burn_in_steps: int, compress_steps: int) -> Optional[Dict[str, Any]]:
+        """Pick one episode (recency-biased) and one training window in it."""
+        with self._lock:
+            n = len(self._episodes)
+            if n == 0:
+                return None
+            while True:
+                idx = random.randrange(n)
+                accept = 1 - (n - 1 - idx) / n
+                if random.random() < accept:
+                    break
+            ep = self._episodes[idx]
+
+        steps = ep["steps"]
+        train_start = random.randrange(1 + max(0, steps - forward_steps))
+        start = max(0, train_start - burn_in_steps)
+        end = min(train_start + forward_steps, steps)
+        first_block = start // compress_steps
+        last_block = (end - 1) // compress_steps + 1
+        return {
+            "args": ep["args"],
+            # outcome as an array ordered like ep['players'] for batching
+            "outcome": np.asarray([ep["outcome"][p] for p in ep["players"]], np.float32),
+            "players": ep["players"],
+            "blocks": ep["blocks"][first_block:last_block],
+            "base": first_block * compress_steps,
+            "start": start,
+            "end": end,
+            "train_start": train_start,
+            "total": steps,
+        }

@@ -1,0 +1,147 @@
+"""Learner-side training loop: batch pipeline + epoch-cadenced SGD thread.
+
+Process topology vs the reference (train.py:271-401): the reference forks
+``num_batchers`` processes for make_batch and trains on the main GPU
+thread.  Here the expensive per-step math is already on the TPU inside one
+jitted call, so the host side is a thread pipeline:
+
+    batcher threads (sample windows + columnar make_batch, numpy)
+      -> host batch queue
+      -> device-put thread (sharded transfer, double-buffered)
+      -> device batch queue
+      -> Trainer.train() loop calling the compiled train step
+
+Epoch handoff keeps the reference semantics (train.py:343-346, 390-401):
+``update()`` flips a flag and blocks on a 1-slot queue for the snapshot;
+the learning rate follows the data-count EMA schedule (train.py:328-332,
+383-385).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..parallel import TrainContext
+from .batch import make_batch
+from .replay import EpisodeStore
+
+
+class BatchPipeline:
+    """Threaded replay -> numpy batch -> sharded device batch pipeline."""
+
+    def __init__(self, args: Dict[str, Any], store: EpisodeStore, ctx: TrainContext):
+        self.args = args
+        self.store = store
+        self.ctx = ctx
+        self._host_queue: queue.Queue = queue.Queue(maxsize=max(2, args["num_batchers"]))
+        self._device_queue: queue.Queue = queue.Queue(maxsize=args.get("prefetch_batches", 2))
+        self._started = False
+
+    def start(self):
+        if self._started:
+            return
+        self._started = True
+        for _ in range(max(1, self.args["num_batchers"])):
+            threading.Thread(target=self._assemble_loop, daemon=True).start()
+        threading.Thread(target=self._device_put_loop, daemon=True).start()
+
+    def _sample_windows(self):
+        windows = []
+        while len(windows) < self.args["batch_size"]:
+            w = self.store.sample_window(
+                self.args["forward_steps"],
+                self.args["burn_in_steps"],
+                self.args["compress_steps"],
+            )
+            if w is None:
+                time.sleep(0.5)
+                continue
+            windows.append(w)
+        return windows
+
+    def _assemble_loop(self):
+        while True:
+            batch = make_batch(self._sample_windows(), self.args)
+            self._host_queue.put(batch)
+
+    def _device_put_loop(self):
+        while True:
+            batch = self._host_queue.get()
+            self._device_queue.put(self.ctx.put_batch(batch))
+
+    def batch(self):
+        return self._device_queue.get()
+
+
+class Trainer:
+    """Runs the SGD loop in a daemon thread; epoch handoff via update()."""
+
+    def __init__(self, args: Dict[str, Any], module, params, mesh):
+        self.args = args
+        self.ctx = TrainContext(module, args, mesh)
+        self.state = self.ctx.init_state(params)
+        self.store = EpisodeStore(args["maximum_episodes"])
+        self.batcher = BatchPipeline(args, self.store, self.ctx)
+
+        self.default_lr = 3e-8
+        self.data_cnt_ema = args["batch_size"] * args["forward_steps"]
+        self.steps = 0
+        self.update_flag = False
+        self.update_queue: queue.Queue = queue.Queue(maxsize=1)
+
+    @property
+    def lr(self) -> float:
+        return self.default_lr * self.data_cnt_ema / (1 + self.steps * 1e-5)
+
+    def params_host(self):
+        return jax.device_get(self.state["params"])
+
+    def update(self):
+        """Request an epoch boundary; blocks until the snapshot is ready."""
+        self.update_flag = True
+        params, steps = self.update_queue.get()
+        return params, steps
+
+    def train_epoch(self) -> Any:
+        """Train until the learner flags an epoch end; return param snapshot."""
+        batch_cnt, data_cnt = 0, 0
+        metric_accum = []
+        lr = self.lr
+        while data_cnt == 0 or not self.update_flag:
+            batch = self.batcher.batch()
+            self.state, metrics = self.ctx.train_step(self.state, batch, lr)
+            metric_accum.append(metrics)
+            batch_cnt += 1
+            self.steps += 1
+            data_cnt = 1  # real count resolved below without device sync per step
+
+        fetched = jax.device_get(metric_accum)
+        data_cnt = float(sum(m["dcnt"] for m in fetched))
+        loss_sum = {
+            k: float(sum(m[k] for m in fetched))
+            for k in fetched[0]
+            if k != "dcnt"
+        }
+        print(
+            "loss = %s"
+            % " ".join(f"{k}:{v / max(data_cnt, 1):.3f}" for k, v in loss_sum.items())
+        )
+        self.data_cnt_ema = self.data_cnt_ema * 0.8 + data_cnt / (1e-2 + batch_cnt) * 0.2
+        return self.params_host()
+
+    def run(self):
+        print("waiting training")
+        while len(self.store) < self.args["minimum_episodes"]:
+            time.sleep(1)
+        self.batcher.start()
+        print("started training")
+        while True:
+            params = self.train_epoch()
+            self.update_flag = False
+            self.update_queue.put((params, self.steps))
