@@ -1,0 +1,100 @@
+"""Headline benchmark: sharded train-step throughput on the default config.
+
+Measures trained env-steps/sec (batch_size x forward_steps per update)
+through the REAL pipeline — self-play episodes -> replay windows ->
+make_batch -> jitted sharded train step — on whatever devices are present
+(one real TPU chip under the driver, virtual CPU devices in tests).
+
+Baseline: the reference (kuto5046/HandyRL) measured on this machine,
+same config (TicTacToe, batch 128 x forward_steps 16, torch CPU):
+    19.39 updates/s = 39,707 trained env-steps/s
+(see BASELINE.md "measured" table; the reference publishes no numbers).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_TRAINED_STEPS_PER_SEC = 39707.0  # measured, BASELINE.md
+
+
+def main() -> None:
+    import jax
+
+    from handyrl_tpu.config import normalize_args
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.models import InferenceModel, RandomModel, init_variables
+    from handyrl_tpu.parallel import TrainContext, make_mesh
+    from handyrl_tpu.runtime import EpisodeStore, Generator, make_batch
+
+    cfg = normalize_args({"env_args": {"env": "TicTacToe"}, "train_args": {}})
+    args = dict(cfg["train_args"])
+    args["env"] = cfg["env_args"]
+
+    n_dev = len(jax.devices())
+    if args["batch_size"] % n_dev:
+        args["batch_size"] = max(n_dev, args["batch_size"] // n_dev * n_dev)
+
+    env = make_env(args["env"])
+    module = env.net()
+    variables = init_variables(module, env)
+    model = InferenceModel(module, variables)
+    env.reset()
+    random_model = RandomModel.from_model(model, env.observation(env.players()[0]))
+
+    # self-play data through the real generator (host-side, no device calls)
+    store = EpisodeStore(1024)
+    gen = Generator(env, args)
+    gen_args = {"player": env.players(), "model_id": {p: 0 for p in env.players()}}
+    while len(store) < 256:
+        ep = gen.generate({p: random_model for p in env.players()}, gen_args)
+        if ep is not None:
+            store.extend([ep])
+
+    def sample_batch():
+        windows = []
+        while len(windows) < args["batch_size"]:
+            w = store.sample_window(
+                args["forward_steps"], args["burn_in_steps"], args["compress_steps"]
+            )
+            if w is not None:
+                windows.append(w)
+        return make_batch(windows, args)
+
+    mesh = make_mesh(args["mesh"])
+    ctx = TrainContext(module, args, mesh)
+    state = ctx.init_state(variables["params"])
+    device_batches = [ctx.put_batch(sample_batch()) for _ in range(4)]
+
+    # warmup (compile)
+    state, metrics = ctx.train_step(state, device_batches[0], 1e-5)
+    jax.block_until_ready(metrics["total"])
+
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < 15.0:
+        state, metrics = ctx.train_step(state, device_batches[n % len(device_batches)], 1e-5)
+        n += 1
+    jax.block_until_ready(metrics["total"])
+    dt = time.perf_counter() - t0
+
+    trained_steps_per_sec = n * args["batch_size"] * args["forward_steps"] / dt
+    print(
+        json.dumps(
+            {
+                "metric": "tictactoe_trained_env_steps_per_sec",
+                "value": round(trained_steps_per_sec, 1),
+                "unit": "env-steps/s",
+                "vs_baseline": round(trained_steps_per_sec / REFERENCE_TRAINED_STEPS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

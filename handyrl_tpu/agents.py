@@ -1,0 +1,132 @@
+"""Agent zoo for evaluation and match play.
+
+Capability parity with reference handyrl/agent.py:13-113: random,
+rule-based, greedy/temperature model agents, ensembles and the T=1.0 soft
+agent.  Models are anything with the ``inference``/``init_hidden`` API —
+an InferenceModel, a BatchedInferenceClient sharing the actor-side engine,
+a RandomModel, or an ensemble thereof.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .utils import softmax
+
+
+class RandomAgent:
+    """Uniform over legal actions (agent.py:13-22)."""
+
+    def reset(self, env, show: bool = False):
+        pass
+
+    def action(self, env, player: int, show: bool = False) -> int:
+        return random.choice(env.legal_actions(player))
+
+    def observe(self, env, player: int, show: bool = False):
+        return [0.0]
+
+
+class RuleBasedAgent(RandomAgent):
+    """Delegates to the environment's scripted policy (agent.py:25-33)."""
+
+    def __init__(self, key: Optional[str] = None):
+        self.key = key
+
+    def action(self, env, player: int, show: bool = False) -> int:
+        if hasattr(env, "rule_based_action"):
+            return env.rule_based_action(player, key=self.key)
+        return random.choice(env.legal_actions(player))
+
+
+def print_outputs(env, prob, v) -> None:
+    if hasattr(env, "print_outputs"):
+        env.print_outputs(prob, v)
+    else:
+        if v is not None:
+            print("v = %f" % v)
+        if prob is not None:
+            print("p = %s" % (prob * 1000).astype(int))
+
+
+class Agent:
+    """Greedy (or temperature-sampled) model agent with hidden-state carry.
+
+    Parity with reference Agent (agent.py:36-89): ``reset`` re-seeds the
+    hidden state, ``action`` masks illegal actions and picks argmax (T=0)
+    or samples p^(1/T), ``observe`` returns the value estimate for
+    non-acting observation steps.
+    """
+
+    def __init__(self, model, temperature: float = 0.0, observation: bool = True):
+        self.model = model
+        self.hidden = None
+        self.temperature = temperature
+        self.observation = observation
+
+    def reset(self, env, show: bool = False):
+        self.hidden = self.model.init_hidden()
+
+    def plan(self, obs) -> Dict[str, Any]:
+        outputs = self.model.inference(obs, self.hidden)
+        self.hidden = outputs.get("hidden")
+        return outputs
+
+    def action(self, env, player: int, show: bool = False) -> int:
+        outputs = self.plan(env.observation(player))
+        actions = env.legal_actions(player)
+        p = np.asarray(outputs["policy"], dtype=np.float32)
+        mask = np.ones_like(p) * 1e32
+        mask[actions] = 0.0
+        p = p - mask
+
+        if show:
+            v = outputs.get("value")
+            print_outputs(env, softmax(p), None if v is None else float(np.reshape(v, -1)[0]))
+
+        if self.temperature == 0:
+            ap_list = sorted([(a, p[a]) for a in actions], key=lambda x: -x[1])
+            return ap_list[0][0]
+        prob = softmax(p / self.temperature)
+        return int(random.choices(np.arange(len(p)), weights=prob)[0])
+
+    def observe(self, env, player: int, show: bool = False):
+        v = None
+        if self.observation:
+            outputs = self.plan(env.observation(player))
+            v = outputs.get("value")
+            if show:
+                print_outputs(env, None, None if v is None else float(np.reshape(v, -1)[0]))
+        return v
+
+
+class EnsembleAgent(Agent):
+    """Mean-pools outputs of several models (agent.py:92-107)."""
+
+    def __init__(self, models, temperature: float = 0.0, observation: bool = True):
+        super().__init__(models[0], temperature, observation)
+        self.models = models
+
+    def reset(self, env, show: bool = False):
+        self.hidden = [model.init_hidden() for model in self.models]
+
+    def plan(self, obs) -> Dict[str, Any]:
+        outputs = {}
+        for i, model in enumerate(self.models):
+            o = model.inference(obs, self.hidden[i])
+            self.hidden[i] = o.get("hidden")
+            for k, v in o.items():
+                if k == "hidden" or v is None:
+                    continue
+                outputs[k] = outputs.get(k, 0) + np.asarray(v) / len(self.models)
+        return outputs
+
+
+class SoftAgent(Agent):
+    """Temperature-1 sampling agent (agent.py:110-112)."""
+
+    def __init__(self, model):
+        super().__init__(model, temperature=1.0)

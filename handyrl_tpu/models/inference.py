@@ -20,6 +20,13 @@ import numpy as np
 from ..utils import tree_map
 
 
+@functools.lru_cache(maxsize=None)
+def jitted_apply(module):
+    """One compiled apply per module *value* (linen modules hash by config),
+    so swapping parameters — e.g. each training epoch — never recompiles."""
+    return jax.jit(module.apply)
+
+
 def init_variables(module, env, seed: int = 0):
     """Initialize model variables from a sample observation of ``env``."""
     env.reset()
@@ -41,9 +48,9 @@ class InferenceModel:
         self.module = module
         self.variables = variables
 
-    @functools.cached_property
+    @property
     def _apply(self):
-        return jax.jit(lambda variables, obs, hidden: self.module.apply(variables, obs, hidden))
+        return jitted_apply(self.module)
 
     def init_hidden(self, batch_dims=()):
         hidden = self.module.initial_state(tuple(batch_dims))

@@ -1,0 +1,27 @@
+from .replay import EpisodeStore, compress_block, decompress_block
+from .batch import make_batch
+from .generation import Generator
+from .evaluation import Evaluator, exec_match, exec_network_match, evaluate_mp
+from .inference_engine import BatchedInferenceEngine
+from .trainer import Trainer
+from .worker import LocalModelServer, LocalWorkerPool, Worker
+from .learner import Learner, train_main
+
+__all__ = [
+    "EpisodeStore",
+    "compress_block",
+    "decompress_block",
+    "make_batch",
+    "Generator",
+    "Evaluator",
+    "exec_match",
+    "exec_network_match",
+    "evaluate_mp",
+    "BatchedInferenceEngine",
+    "Trainer",
+    "LocalModelServer",
+    "LocalWorkerPool",
+    "Worker",
+    "Learner",
+    "train_main",
+]

@@ -1,0 +1,242 @@
+"""Match execution and evaluation harnesses.
+
+Capability parity with reference handyrl/evaluation.py:
+* ``exec_match`` — shared-env match loop (evaluation.py:83-109).
+* ``exec_network_match`` — split-env match driven by diff_info/update
+  deltas (evaluation.py:112-141); agents carry their own replica env.
+* ``Evaluator`` — worker-side model-vs-opponent evaluation
+  (evaluation.py:153-177).
+* ``evaluate`` / ``evaluate_mp`` — standalone eval with first/second
+  balancing and per-pattern win-rate report (evaluation.py:180-261).
+
+TPU-first difference: parallel evaluation uses a thread pool sharing one
+jitted model (optionally through the batched inference engine) instead of
+forking processes that each re-compile; the env step is cheap host python,
+the model call is the device-bound part.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..agents import Agent, RandomAgent, RuleBasedAgent, SoftAgent
+from ..envs import make_env
+from ..models import InferenceModel
+from .checkpoint import load_params
+
+
+def view(env, player: Optional[int] = None) -> None:
+    if hasattr(env, "view"):
+        env.view(player=player)
+    else:
+        print(env)
+
+
+def exec_match(env, agents: Dict[int, Any], critic=None, show: bool = False, game_args=None):
+    """Run one match on a shared env; returns outcome dict or None on error."""
+    if env.reset(game_args or {}):
+        return None
+    for agent in agents.values():
+        agent.reset(env, show=show)
+    while not env.terminal():
+        if show:
+            view(env)
+        turn_players = env.turns()
+        observers = env.observers()
+        actions = {}
+        for p, agent in agents.items():
+            if p in turn_players:
+                actions[p] = agent.action(env, p, show=show)
+            elif p in observers:
+                agent.observe(env, p, show=show)
+        if env.step(actions):
+            return None
+        if show and critic is not None:
+            print("cv = ", critic.observe(env, None, show=False)[0])
+    if show:
+        view(env)
+        print("final outcome = %s" % env.outcome())
+    return env.outcome()
+
+
+def exec_network_match(env, network_agents: Dict[int, Any], critic=None, show: bool = False, game_args=None):
+    """Split-env match: each agent holds a replica env synced by deltas."""
+    if env.reset(game_args or {}):
+        return None
+    for p, agent in network_agents.items():
+        info = env.diff_info(p)
+        agent.update(info, True)
+    while not env.terminal():
+        if show:
+            view(env)
+        turn_players = env.turns()
+        observers = env.observers()
+        actions = {}
+        for p, agent in network_agents.items():
+            if p in turn_players:
+                action = agent.action(p)
+                actions[p] = env.str2action(action, p)
+            elif p in observers:
+                agent.observe(p)
+        if env.step(actions):
+            return None
+        for p, agent in network_agents.items():
+            info = env.diff_info(p)
+            agent.update(info, False)
+    outcome = env.outcome()
+    for p, agent in network_agents.items():
+        agent.outcome(outcome[p])
+    return outcome
+
+
+def build_agent(raw: Any, env=None) -> Optional[Any]:
+    """'random' / 'rulebase[-key]' spec -> agent (evaluation.py:144-150)."""
+    if raw == "random":
+        return RandomAgent()
+    if isinstance(raw, str) and raw.startswith("rulebase"):
+        key = raw.split("-")[1] if "-" in raw else None
+        return RuleBasedAgent(key)
+    return None
+
+
+def load_model_agent(model_path: str, env, module=None) -> Agent:
+    """Checkpoint path -> greedy Agent over a jitted InferenceModel."""
+    from ..models import init_variables
+
+    module = module or env.net()
+    variables = init_variables(module, env)
+    params = load_params(model_path, variables["params"])
+    return Agent(InferenceModel(module, {"params": params}))
+
+
+class Evaluator:
+    """Worker-side evaluation executor (evaluation.py:153-177)."""
+
+    def __init__(self, env, args: Dict[str, Any]):
+        self.env = env
+        self.args = args
+        self.opponent = args.get("eval", {}).get("opponent", ["random"])
+        if not isinstance(self.opponent, list):
+            self.opponent = [self.opponent]
+
+    def execute(self, models: Dict[int, Any], args: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        opponents = [o for o in self.opponent if build_agent(o, self.env) is not None] or ["random"]
+        opponent = random.choice(opponents)
+
+        agents = {}
+        for p in self.env.players():
+            if p in args["player"]:
+                agents[p] = Agent(models[p], observation=self.args.get("observation", False))
+            else:
+                agents[p] = build_agent(opponent, self.env)
+        outcome = exec_match(self.env, agents)
+        if outcome is None:
+            print("None episode in evaluation!")
+            return None
+        return {"args": args, "result": outcome, "opponent": opponent}
+
+
+def wp_func(results: Dict[Any, int]) -> float:
+    """Win points: 1 per win, 0.5 per draw, over finished games."""
+    games = sum(results.values())
+    win = sum(v for k, v in results.items() if k is not None and k > 0)
+    draw = sum(v for k, v in results.items() if k == 0)
+    return (win + draw / 2) / max(games, 1e-6)
+
+
+def evaluate_mp(env_args: Dict[str, Any], agents: Dict[int, Any], num_games: int, num_workers: int = 4, seed: int = 0):
+    """Parallel evaluation over a thread pool with first/second balancing.
+
+    Returns {pattern: {outcome: count}} keyed by the player-order pattern.
+    """
+    players = make_env(env_args).players()
+    patterns: List[List[int]] = []
+    if len(players) == 2:
+        # balance first/second seats (evaluation.py:216-219)
+        patterns = [[0, 1], [1, 0]]
+    else:
+        patterns = [list(players)]
+
+    jobs: List = []
+    for i in range(num_games):
+        pat = patterns[i % len(patterns)]
+        jobs.append((i, pat))
+
+    results: Dict[str, Dict[Any, int]] = {str(p): {} for p in patterns}
+    lock = threading.Lock()
+    job_iter = iter(jobs)
+
+    def run():
+        env = make_env(env_args)
+        while True:
+            with lock:
+                job = next(job_iter, None)
+            if job is None:
+                return
+            _, pat = job
+            # pattern maps seat -> agent key; agents keyed by original order
+            seat_agents = {seat: agents[pat[idx]] for idx, seat in enumerate(env.players())}
+            outcome = exec_match(env, seat_agents)
+            if outcome is None:
+                continue
+            # score from agent 0's perspective wherever it sat
+            seat0 = env.players()[pat.index(0)]
+            o = outcome[seat0]
+            with lock:
+                results[str(pat)][o] = results[str(pat)].get(o, 0) + 1
+
+    threads = [threading.Thread(target=run) for _ in range(max(1, num_workers))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    total: Dict[Any, int] = {}
+    for pat, res in results.items():
+        games = sum(res.values())
+        print("%s = %.3f (%d)" % (pat, wp_func(res), games))
+        for k, v in res.items():
+            total[k] = total.get(k, 0) + v
+    print("total = %.3f (%d)" % (wp_func(total), sum(total.values())))
+    return results
+
+
+def eval_main(args: Dict[str, Any], argv: List[str]) -> None:
+    """`main.py --eval MODEL_PATH NUM_GAMES NUM_PROCESS` (evaluation.py:377-404).
+
+    MODEL_PATH may be 'random', 'rulebase[-key]', a checkpoint path, or a
+    ':'-joined list of checkpoint paths (ensemble).
+    """
+    from ..agents import EnsembleAgent
+    from ..envs import prepare_env
+    from ..models import InferenceModel, init_variables
+
+    env_args = args["env_args"]
+    prepare_env(env_args)
+    env = make_env(env_args)
+
+    raw = argv[0] if argv else "models/latest.ckpt"
+    num_games = int(argv[1]) if len(argv) >= 2 else 100
+    num_workers = int(argv[2]) if len(argv) >= 3 else 4
+
+    def resolve(spec: str):
+        agent = build_agent(spec, env)
+        if agent is not None:
+            return agent
+        paths = spec.split(":")
+        if len(paths) > 1:
+            module = env.net()
+            variables = init_variables(module, env)
+            models = [
+                InferenceModel(module, {"params": load_params(p, variables["params"])})
+                for p in paths
+            ]
+            return EnsembleAgent(models)
+        return load_model_agent(spec, env)
+
+    agents = {0: resolve(raw), 1: build_agent("random", env) or RandomAgent()}
+    evaluate_mp(env_args, agents, num_games, num_workers)

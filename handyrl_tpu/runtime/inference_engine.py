@@ -1,0 +1,150 @@
+"""Cross-environment batched inference engine (the actor-side TPU path).
+
+The reference runs batch-1 CPU inference inside every worker process
+(handyrl/model.py:50-60 via generation.py:45) — fine for torch-CPU, fatal
+for a TPU whose MXU wants large batches.  Here many host-side actor threads
+share ONE device model: each submits its (obs, hidden) and blocks on a
+future; a dispatcher thread drains the request queue, stacks observations
+into a single padded batch, runs one jitted apply, and scatters results.
+
+Static shapes: batches are padded to power-of-two buckets up to
+``max_batch`` so XLA compiles a handful of shapes, not one per batch size.
+
+Recurrent models: per-request hidden pytrees are stacked alongside the
+observations; requests with ``hidden=None`` get the module's initial state
+slice so one batch can mix fresh and mid-episode environments.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils import tree_map, tree_stack
+
+
+def _next_bucket(n: int, max_batch: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, max_batch)
+
+
+class BatchedInferenceClient:
+    """Per-actor facade with the reference inference API (model.py:50-60)."""
+
+    def __init__(self, engine: "BatchedInferenceEngine"):
+        self._engine = engine
+
+    def init_hidden(self, batch_dims=()):
+        return self._engine.init_hidden(batch_dims)
+
+    def inference(self, obs, hidden=None) -> Dict[str, Any]:
+        return self._engine.submit(obs, hidden).result()
+
+
+class BatchedInferenceEngine:
+    """One device model serving many actor threads with batched inference."""
+
+    def __init__(self, model, max_batch: int = 64, max_wait_ms: float = 2.0):
+        self.model = model  # InferenceModel (numpy in/out, jitted apply)
+        self.max_batch = max(1, max_batch)
+        self.max_wait = max_wait_ms / 1000.0
+        self._queue: queue.Queue = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.batches_served = 0
+        self.requests_served = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "BatchedInferenceEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._serve_loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._queue.put(None)
+
+    def update_model(self, model) -> None:
+        """Swap in new variables (same module); takes effect next batch."""
+        self.model = model
+
+    # -- client API ---------------------------------------------------------
+
+    def init_hidden(self, batch_dims=()):
+        return self.model.init_hidden(batch_dims)
+
+    def client(self) -> BatchedInferenceClient:
+        return BatchedInferenceClient(self)
+
+    def submit(self, obs, hidden=None) -> Future:
+        fut: Future = Future()
+        self._queue.put((obs, hidden, fut))
+        return fut
+
+    # -- dispatcher ---------------------------------------------------------
+
+    def _drain(self) -> List:
+        """Block for the first request, then gather more up to max_batch."""
+        first = self._queue.get()
+        if first is None:
+            return []
+        requests = [first]
+        deadline = time.monotonic() + self.max_wait
+        while len(requests) < self.max_batch:
+            timeout = deadline - time.monotonic()
+            try:
+                if timeout <= 0:
+                    item = self._queue.get_nowait()
+                else:
+                    item = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                break
+            if item is None:
+                break
+            requests.append(item)
+        return requests
+
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            requests = self._drain()
+            if not requests:
+                continue
+            try:
+                self._serve(requests)
+            except Exception as exc:  # propagate to every waiter
+                for _, _, fut in requests:
+                    if not fut.done():
+                        fut.set_exception(exc)
+
+    def _serve(self, requests: List) -> None:
+        model = self.model
+        n = len(requests)
+        bucket = _next_bucket(n, self.max_batch)
+
+        obs_list = [r[0] for r in requests]
+        obs_list += [obs_list[0]] * (bucket - n)
+        obs_batch = tree_stack(obs_list)
+
+        hidden_batch = None
+        template = model.init_hidden()
+        if template is not None:
+            hid_list = [r[1] if r[1] is not None else template for r in requests]
+            hid_list += [template] * (bucket - n)
+            hidden_batch = tree_stack(hid_list)
+
+        outputs = model.inference_batch(obs_batch, hidden_batch)
+        outputs = tree_map(np.asarray, outputs)
+        for i, (_, _, fut) in enumerate(requests):
+            fut.set_result(tree_map(lambda x: x[i], outputs))
+
+        self.batches_served += 1
+        self.requests_served += n

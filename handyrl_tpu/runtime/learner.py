@@ -1,0 +1,278 @@
+"""Central learner: role assignment, episode ingestion, epoch cadence.
+
+Semantics parity with reference Learner (handyrl/train.py:404-633):
+
+* role assignment 'g'/'e' with effective eval rate
+  ``max(eval_rate, update_episodes**-0.15)`` (train.py:415-416, 564-576);
+* per-model-id generation stats and per-opponent evaluation aggregation
+  (train.py:457-500);
+* epoch boundary every ``update_episodes`` returned episodes after a
+  ``minimum_episodes`` warmup; trainer handoff; epoch-indexed checkpoints
+  (train.py:540-626);
+* shutdown after ``epochs`` epochs; 'args' answered None so workers drain.
+
+TPU-first differences: workers are in-process threads sharing the batched
+inference engine (runtime/worker.py), requests arrive on a queue consumed
+by this single server loop (the reference's QueueCommunicator collapses to
+queue.Queue — no sockets locally), and each epoch appends a machine-
+readable metrics record (metrics.jsonl) alongside the human log lines the
+reference's plotters parse (win_rate_plot.py:34-45).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import random
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from ..envs import make_env, prepare_env
+from ..models import init_variables
+from ..parallel import make_mesh
+from .checkpoint import (
+    latest_model_path,
+    load_params,
+    model_path,
+    save_params,
+    save_train_state,
+)
+from .trainer import Trainer
+from .worker import LocalModelServer, LocalWorkerPool
+
+
+class Learner:
+    def __init__(self, args: Dict[str, Any], net=None, remote: bool = False):
+        train_args = dict(args["train_args"])
+        train_args["env"] = args["env_args"]
+        self.args = train_args
+        random.seed(self.args["seed"])
+
+        prepare_env(args["env_args"])
+        self.env = make_env(args["env_args"])
+        eval_modify_rate = (self.args["update_episodes"] ** 0.85) / self.args["update_episodes"]
+        self.eval_rate = max(self.args["eval_rate"], eval_modify_rate)
+        self.shutdown_flag = False
+
+        self.model_dir = self.args.get("model_dir", "models")
+        self.module = net if net is not None else self.env.net()
+        variables = init_variables(self.module, self.env, self.args["seed"])
+        params = variables["params"]
+
+        self.model_epoch = self.args["restart_epoch"]
+        if self.model_epoch > 0:
+            params = load_params(model_path(self.model_dir, self.model_epoch), params)
+
+        # generated datum
+        self.generation_results: Dict[int, tuple] = {}
+        self.num_episodes = 0
+        self.num_returned_episodes = 0
+
+        # evaluated datum
+        self.results: Dict[int, tuple] = {}
+        self.results_per_opponent: Dict[int, Dict[str, tuple]] = {}
+        self.num_results = 0
+
+        mesh = make_mesh(self.args.get("mesh"))
+        self.trainer = Trainer(self.args, self.module, params, mesh)
+        self.model_server = LocalModelServer(self.module, make_env(args["env_args"]), self.args)
+        self.model_server.publish(self.model_epoch, params)
+
+        if remote:
+            from .server import WorkerServer  # noqa: avoid socket deps locally
+
+            self.worker = WorkerServer(self.args, self.handle, self.model_server)
+        else:
+            self.worker = LocalWorkerPool(self.args, self.handle, self.model_server)
+
+        self._requests: queue.Queue = queue.Queue()
+        self._active_workers = 0
+        self._epoch_t0 = time.time()
+        self._epoch_steps0 = 0
+        self._epoch_episodes0 = 0
+        self._trainer_thread: Optional[threading.Thread] = None
+
+    # -- request plumbing ---------------------------------------------------
+
+    def handle(self, req: str, data: Any) -> Any:
+        """Thread-safe entry point for workers; blocks until served."""
+        fut: Future = Future()
+        self._requests.put((req, data, fut))
+        return fut.result()
+
+    # -- bookkeeping (train.py:457-500) -------------------------------------
+
+    def feed_episodes(self, episodes: List[Optional[Dict]]) -> None:
+        for episode in episodes:
+            if episode is None:
+                continue
+            for p in episode["args"]["player"]:
+                model_id = episode["args"]["model_id"][p]
+                outcome = episode["outcome"][p]
+                n, r, r2 = self.generation_results.get(model_id, (0, 0, 0))
+                self.generation_results[model_id] = n + 1, r + outcome, r2 + outcome ** 2
+            self.num_returned_episodes += 1
+            if self.num_returned_episodes % 100 == 0:
+                print(self.num_returned_episodes, end=" ", flush=True)
+        self.trainer.store.extend(episodes)
+
+    def feed_results(self, results: List[Optional[Dict]]) -> None:
+        for result in results:
+            if result is None:
+                continue
+            for p in result["args"]["player"]:
+                model_id = result["args"]["model_id"][p]
+                res = result["result"][p]
+                n, r, r2 = self.results.get(model_id, (0, 0, 0))
+                self.results[model_id] = n + 1, r + res, r2 + res ** 2
+                per_opp = self.results_per_opponent.setdefault(model_id, {})
+                n, r, r2 = per_opp.get(result["opponent"], (0, 0, 0))
+                per_opp[result["opponent"]] = n + 1, r + res, r2 + res ** 2
+
+    # -- epoch boundary (train.py:502-538) -----------------------------------
+
+    def _win_rate(self, stats) -> tuple:
+        n, r, _ = stats
+        mean = r / (n + 1e-6)
+        return (mean + 1) / 2, n
+
+    def update(self) -> None:
+        print()
+        print("epoch %d" % self.model_epoch)
+        record: Dict[str, Any] = {"epoch": self.model_epoch}
+
+        if self.model_epoch not in self.results:
+            print("win rate = Nan (0)")
+        else:
+            def output_wp(name, stats):
+                wr, n = self._win_rate(stats)
+                tag = " (%s)" % name if name else ""
+                print("win rate%s = %.3f (%.1f / %d)" % (tag, wr, wr * n, n))
+                record.setdefault("win_rate", {})[name or "total"] = wr
+
+            per_opp = self.results_per_opponent.get(self.model_epoch, {})
+            if len(self.args.get("eval", {}).get("opponent", [])) <= 1 and len(per_opp) <= 1:
+                output_wp("", self.results[self.model_epoch])
+            else:
+                output_wp("total", self.results[self.model_epoch])
+                for key in sorted(per_opp):
+                    output_wp(key, per_opp[key])
+
+        if self.model_epoch not in self.generation_results:
+            print("generation stats = Nan (0)")
+        else:
+            n, r, r2 = self.generation_results[self.model_epoch]
+            mean = r / (n + 1e-6)
+            std = max(r2 / (n + 1e-6) - mean ** 2, 0.0) ** 0.5
+            print("generation stats = %.3f +- %.3f" % (mean, std))
+            record["generation_mean"] = mean
+
+        params, steps = self.trainer.update()
+        if params is None:
+            params = self.model_server.latest_params()
+        self.update_model(params, steps)
+
+        now = time.time()
+        record.update(
+            steps=steps,
+            episodes=self.num_returned_episodes,
+            episodes_per_sec=(self.num_returned_episodes - self._epoch_episodes0) / max(now - self._epoch_t0, 1e-6),
+            updates_per_sec=(steps - self._epoch_steps0) / max(now - self._epoch_t0, 1e-6),
+        )
+        self._epoch_t0 = now
+        self._epoch_steps0 = steps
+        self._epoch_episodes0 = self.num_returned_episodes
+        self._write_metrics(record)
+
+    def update_model(self, params, steps: int) -> None:
+        print("updated model(%d)" % steps)
+        self.model_epoch += 1
+        save_params(model_path(self.model_dir, self.model_epoch), params)
+        save_params(latest_model_path(self.model_dir), params)
+        save_train_state(os.path.join(self.model_dir, "state.ckpt"), self.trainer.state_host)
+        self.model_server.publish(self.model_epoch, params)
+
+    def _write_metrics(self, record: Dict[str, Any]) -> None:
+        path = self.args.get("metrics_path")
+        if not path:
+            return
+        with open(path, "a") as f:
+            f.write(json.dumps(record, default=float) + "\n")
+
+    # -- server loop (train.py:540-626) --------------------------------------
+
+    def _assign_role(self) -> Dict[str, Any]:
+        args: Dict[str, Any] = {"model_id": {}}
+        if self.num_results < self.eval_rate * self.num_episodes:
+            args["role"] = "e"
+            players = self.env.players()
+            me = players[self.num_results % len(players)]
+            args["player"] = [me]
+            args["model_id"] = {p: (self.model_epoch if p == me else -1) for p in players}
+            self.num_results += 1
+        else:
+            args["role"] = "g"
+            args["player"] = self.env.players()
+            args["model_id"] = {p: self.model_epoch for p in self.env.players()}
+            self.num_episodes += 1
+        return args
+
+    def server(self) -> None:
+        print("started server")
+        prev_update_episodes = self.args["minimum_episodes"]
+        next_update_episodes = prev_update_episodes + self.args["update_episodes"]
+
+        while self._active_workers > 0 or not self.shutdown_flag:
+            try:
+                req, data, fut = self._requests.get(timeout=0.3)
+            except queue.Empty:
+                continue
+
+            if req == "args":
+                if self.shutdown_flag:
+                    fut.set_result(None)
+                    self._active_workers -= 1
+                else:
+                    fut.set_result(self._assign_role())
+            elif req == "episode":
+                self.feed_episodes([data] if not isinstance(data, list) else data)
+                fut.set_result(None)
+            elif req == "result":
+                self.feed_results([data] if not isinstance(data, list) else data)
+                fut.set_result(None)
+            elif req == "model":
+                fut.set_result(self.model_server.get(data))
+            else:
+                fut.set_result(None)
+
+            if self.num_returned_episodes >= next_update_episodes:
+                prev_update_episodes = next_update_episodes
+                next_update_episodes = prev_update_episodes + self.args["update_episodes"]
+                self.update()
+                if self.args["epochs"] >= 0 and self.model_epoch >= self.args["epochs"]:
+                    self.shutdown_flag = True
+        self.trainer.stop()
+        self.model_server.engine.stop()
+        if self._trainer_thread is not None:
+            self._trainer_thread.join(timeout=30)
+        print("finished server")
+
+    def run(self) -> None:
+        self._trainer_thread = threading.Thread(target=self.trainer.run, daemon=True)
+        self._trainer_thread.start()
+        self.worker.run()
+        self._active_workers = len(getattr(self.worker, "threads", [])) or self.args["worker"]["num_parallel"]
+        self.server()
+
+
+def train_main(args: Dict[str, Any]) -> None:
+    learner = Learner(args)
+    learner.run()
+
+
+def train_server_main(args: Dict[str, Any]) -> None:
+    learner = Learner(args, remote=True)
+    learner.run()

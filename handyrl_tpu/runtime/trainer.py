@@ -22,6 +22,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import traceback
 from typing import Any, Dict, Optional
 
 import jax
@@ -35,10 +36,11 @@ from .replay import EpisodeStore
 class BatchPipeline:
     """Threaded replay -> numpy batch -> sharded device batch pipeline."""
 
-    def __init__(self, args: Dict[str, Any], store: EpisodeStore, ctx: TrainContext):
+    def __init__(self, args: Dict[str, Any], store: EpisodeStore, ctx: TrainContext, stop_event: Optional[threading.Event] = None):
         self.args = args
         self.store = store
         self.ctx = ctx
+        self.stop_event = stop_event or threading.Event()
         self._host_queue: queue.Queue = queue.Queue(maxsize=max(2, args["num_batchers"]))
         self._device_queue: queue.Queue = queue.Queue(maxsize=args.get("prefetch_batches", 2))
         self._started = False
@@ -54,6 +56,8 @@ class BatchPipeline:
     def _sample_windows(self):
         windows = []
         while len(windows) < self.args["batch_size"]:
+            if self.stop_event.is_set():
+                return None
             w = self.store.sample_window(
                 self.args["forward_steps"],
                 self.args["burn_in_steps"],
@@ -65,18 +69,49 @@ class BatchPipeline:
             windows.append(w)
         return windows
 
+    def _put(self, q: queue.Queue, item) -> bool:
+        while not self.stop_event.is_set():
+            try:
+                q.put(item, timeout=0.3)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _get(self, q: queue.Queue):
+        while not self.stop_event.is_set():
+            try:
+                return q.get(timeout=0.3)
+            except queue.Empty:
+                continue
+        return None
+
     def _assemble_loop(self):
-        while True:
-            batch = make_batch(self._sample_windows(), self.args)
-            self._host_queue.put(batch)
+        try:
+            while not self.stop_event.is_set():
+                windows = self._sample_windows()
+                if windows is None:
+                    return
+                self._put(self._host_queue, make_batch(windows, self.args))
+        except Exception:
+            # a dead silent pipeline deadlocks the trainer — fail loudly
+            traceback.print_exc()
+            self.stop_event.set()
 
     def _device_put_loop(self):
-        while True:
-            batch = self._host_queue.get()
-            self._device_queue.put(self.ctx.put_batch(batch))
+        try:
+            while not self.stop_event.is_set():
+                batch = self._get(self._host_queue)
+                if batch is None:
+                    return
+                self._put(self._device_queue, self.ctx.put_batch(batch))
+        except Exception:
+            traceback.print_exc()
+            self.stop_event.set()
 
     def batch(self):
-        return self._device_queue.get()
+        """Next device batch, or None when shutting down."""
+        return self._get(self._device_queue)
 
 
 class Trainer:
@@ -86,8 +121,12 @@ class Trainer:
         self.args = args
         self.ctx = TrainContext(module, args, mesh)
         self.state = self.ctx.init_state(params)
+        # Host snapshot for checkpointing: the device state is donated into
+        # every train step, so other threads must never read self.state.
+        self.state_host = jax.device_get(self.state)
         self.store = EpisodeStore(args["maximum_episodes"])
-        self.batcher = BatchPipeline(args, self.store, self.ctx)
+        self.stop_event = threading.Event()
+        self.batcher = BatchPipeline(args, self.store, self.ctx, self.stop_event)
 
         self.default_lr = 3e-8
         self.data_cnt_ema = args["batch_size"] * args["forward_steps"]
@@ -100,13 +139,23 @@ class Trainer:
         return self.default_lr * self.data_cnt_ema / (1 + self.steps * 1e-5)
 
     def params_host(self):
-        return jax.device_get(self.state["params"])
+        return self.state_host["params"]
 
     def update(self):
-        """Request an epoch boundary; blocks until the snapshot is ready."""
+        """Request an epoch boundary; blocks until the snapshot is ready.
+
+        Before the warmup threshold no training has happened — return
+        immediately so the learner keeps serving (reference train.py:343-346).
+        """
+        if len(self.store) < self.args["minimum_episodes"]:
+            return None, self.steps
         self.update_flag = True
-        params, steps = self.update_queue.get()
-        return params, steps
+        while not self.stop_event.is_set():
+            try:
+                return self.update_queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+        return None, self.steps
 
     def train_epoch(self) -> Any:
         """Train until the learner flags an epoch end; return param snapshot."""
@@ -115,11 +164,15 @@ class Trainer:
         lr = self.lr
         while data_cnt == 0 or not self.update_flag:
             batch = self.batcher.batch()
+            if batch is None:  # shutting down
+                break
             self.state, metrics = self.ctx.train_step(self.state, batch, lr)
             metric_accum.append(metrics)
             batch_cnt += 1
             self.steps += 1
             data_cnt = 1  # real count resolved below without device sync per step
+        if not metric_accum:
+            return self.state_host["params"]
 
         fetched = jax.device_get(metric_accum)
         data_cnt = float(sum(m["dcnt"] for m in fetched))
@@ -133,15 +186,21 @@ class Trainer:
             % " ".join(f"{k}:{v / max(data_cnt, 1):.3f}" for k, v in loss_sum.items())
         )
         self.data_cnt_ema = self.data_cnt_ema * 0.8 + data_cnt / (1e-2 + batch_cnt) * 0.2
-        return self.params_host()
+        self.state_host = jax.device_get(self.state)
+        return self.state_host["params"]
+
+    def stop(self):
+        self.stop_event.set()
 
     def run(self):
         print("waiting training")
         while len(self.store) < self.args["minimum_episodes"]:
+            if self.stop_event.is_set():
+                return
             time.sleep(1)
         self.batcher.start()
         print("started training")
-        while True:
+        while not self.stop_event.is_set():
             params = self.train_epoch()
             self.update_flag = False
             self.update_queue.put((params, self.steps))

@@ -1,0 +1,63 @@
+"""CLI entry point — mode dispatch parity with reference main.py:8-38.
+
+Modes:
+    --train / -t             standalone training (learner + local actors)
+    --train-server / -ts     learner serving remote TCP workers
+    --worker / -w            worker machine connecting to a train server
+    --eval / -e              MODEL_PATH NUM_GAMES NUM_PROCESS
+    --eval-server / -es      network battle server
+    --eval-client / -ec      network battle client
+"""
+
+import sys
+
+import yaml
+
+from handyrl_tpu.config import normalize_args
+
+
+def load_args(path: str = "config.yaml"):
+    with open(path) as f:
+        return normalize_args(yaml.safe_load(f) or {})
+
+
+if __name__ == "__main__":
+    try:
+        args = load_args()
+    except FileNotFoundError:
+        args = None
+    print(sys.argv)
+
+    if len(sys.argv) < 2:
+        print("Please set mode of HandyRL-TPU.")
+        sys.exit(1)
+
+    mode = sys.argv[1]
+
+    if mode in ("--train", "-t"):
+        from handyrl_tpu.runtime.learner import train_main
+
+        train_main(args)
+    elif mode in ("--train-server", "-ts"):
+        from handyrl_tpu.runtime.learner import train_server_main
+
+        train_server_main(args)
+    elif mode in ("--worker", "-w"):
+        from handyrl_tpu.runtime.server import worker_main
+
+        worker_main(args, sys.argv)
+    elif mode in ("--eval", "-e"):
+        from handyrl_tpu.runtime.evaluation import eval_main
+
+        eval_main(args, sys.argv[2:])
+    elif mode in ("--eval-server", "-es"):
+        from handyrl_tpu.runtime.battle import eval_server_main
+
+        eval_server_main(args, sys.argv[2:])
+    elif mode in ("--eval-client", "-ec"):
+        from handyrl_tpu.runtime.battle import eval_client_main
+
+        eval_client_main(args, sys.argv[2:])
+    else:
+        print("Unknown mode %s" % mode)
+        sys.exit(1)
