@@ -36,7 +36,7 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     "epochs": -1,
     "num_batchers": 2,
     "eval_rate": 0.1,
-    "worker": {"num_parallel": 6},
+    "worker": {"num_parallel": 6, "entry_port": 9999, "data_port": 9998},
     "lambda": 0.7,
     "policy_target": "TD",
     "value_target": "TD",
@@ -49,11 +49,13 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     "prefetch_batches": 2,
     "metrics_path": "metrics.jsonl",
     "model_dir": "models",
+    "battle_port": 9876,
 }
 
 DEFAULT_WORKER_ARGS: Dict[str, Any] = {
     "server_address": "",
     "num_parallel": 8,
+    "entry_port": 9999,
 }
 
 VALID_TARGETS = ("MC", "TD", "UPGO", "VTRACE")

@@ -29,20 +29,27 @@ def make_mesh(spec: Optional[Dict[str, int]] = None, devices: Optional[Sequence]
 
     make_mesh({'dp': -1})            # all devices data-parallel
     make_mesh({'dp': 4, 'mp': 2})    # 4x2 two-axis mesh
+    make_mesh({'dp': 2})             # sub-mesh on the first 2 devices
+
+    All-positive axis sizes may cover a prefix of the devices (sub-mesh,
+    e.g. to pin the learner to some chips); -1 axes fill what remains.
     """
     devices = list(devices if devices is not None else jax.devices())
     spec = dict(spec or {"dp": -1})
     n = len(devices)
     fixed = math.prod(s for s in spec.values() if s > 0)
-    if n % max(fixed, 1) != 0:
-        raise ValueError(f"{n} devices not divisible by fixed mesh axes {spec}")
-    fill = n // fixed
-    sizes = tuple(s if s > 0 else fill for s in spec.values())
-    if math.prod(sizes) != n:
-        raise ValueError(f"mesh {dict(zip(spec, sizes))} does not cover {n} devices")
+    if any(s <= 0 for s in spec.values()):
+        if n % max(fixed, 1) != 0:
+            raise ValueError(f"{n} devices not divisible by fixed mesh axes {spec}")
+        fill = n // fixed
+        sizes = tuple(s if s > 0 else fill for s in spec.values())
+    else:
+        sizes = tuple(spec.values())
+    if math.prod(sizes) > n:
+        raise ValueError(f"mesh {dict(zip(spec, sizes))} needs more than {n} devices")
     import numpy as np
 
-    return Mesh(np.asarray(devices).reshape(sizes), tuple(spec.keys()))
+    return Mesh(np.asarray(devices[: math.prod(sizes)]).reshape(sizes), tuple(spec.keys()))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,197 @@
+"""Network battle mode: agents on different machines play one game.
+
+Capability parity with reference handyrl/evaluation.py: the server owns
+the master env and drives ``exec_network_match`` over per-player socket
+proxies (``NetworkAgent``, evaluation.py:66-80); each client owns a
+replica env synchronised purely through ``diff_info``/``update`` deltas
+and a local agent (``NetworkAgentClient``, evaluation.py:32-63); entry
+points mirror ``eval_server_main``/``eval_client_main``
+(evaluation.py:407-436).  Default port 9876 (evaluation.py:15).
+
+The wire carries only the pickle-free codec frames (runtime/codec.py) —
+env deltas must therefore be codec-encodable (str/bytes/numbers/pytrees),
+which all bundled envs satisfy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..envs import make_env, prepare_env
+from .connection import (
+    FramedConnection,
+    accept_socket_connections,
+    connect_socket_connection,
+    send_recv,
+)
+from .evaluation import build_agent, exec_network_match, load_model_agent, wp_func
+
+BATTLE_PORT = 9876
+
+
+class NetworkAgentClient:
+    """Client-side command loop: local agent + replica env (evaluation.py:32-63)."""
+
+    def __init__(self, agent, env, conn: FramedConnection):
+        self.agent = agent
+        self.env = env
+        self.conn = conn
+
+    def run(self) -> None:
+        while True:
+            try:
+                command, args = self.conn.recv()
+            except (ConnectionResetError, EOFError, OSError):
+                break
+            if command == "quit":
+                break
+            elif command == "outcome":
+                print("outcome = %f" % args)
+                self.conn.send(None)
+            elif hasattr(self.agent, command):
+                if command == "action":
+                    player = args
+                    ret = self.agent.action(self.env, player)
+                    ret = self.env.action2str(ret, player)
+                else:  # reset / observe
+                    ret = getattr(self.agent, command)(self.env, args)
+                    if ret is not None:
+                        ret = [float(x) for x in np.reshape(np.asarray(ret), (-1,))]
+                self.conn.send(ret)
+            elif command == "update":
+                info, reset = args
+                self.env.update(info, reset)
+                self.conn.send(None)
+            else:
+                self.conn.send(None)
+
+
+class NetworkAgent:
+    """Server-side RPC proxy for a remote client (evaluation.py:66-80)."""
+
+    def __init__(self, conn: FramedConnection):
+        self.conn = conn
+
+    def update(self, data, reset: bool):
+        return send_recv(self.conn, ("update", (data, reset)))
+
+    def outcome(self, outcome):
+        return send_recv(self.conn, ("outcome", float(outcome)))
+
+    def action(self, player: int):
+        return send_recv(self.conn, ("action", player))
+
+    def observe(self, player: int):
+        return send_recv(self.conn, ("observe", player))
+
+
+def network_match_acception(n_games: int, env_args: Dict[str, Any], num_agents: int, port: int):
+    """Yield a group of num_agents client conns per game (evaluation.py:264-284).
+
+    Groups are yielded as soon as they fill so matches start while later
+    clients are still joining — clients that play game after game can
+    reconnect between yields without deadlocking the accept loop.
+    """
+    from .connection import open_socket_connection
+
+    waiting_conns: List[FramedConnection] = []
+    games = 0
+    sock = open_socket_connection(port)
+    try:
+        for conn in accept_socket_connections(sock=sock):
+            if conn is None:
+                continue
+            conn.send(env_args)  # every client learns the env on join
+            waiting_conns.append(conn)
+            if len(waiting_conns) == num_agents:
+                group, waiting_conns = waiting_conns, []
+                yield group
+                games += 1
+            if games >= n_games:
+                return
+    finally:
+        # refuse further joins and release stranded half-group clients, so
+        # clients see 'server is gone' instead of blocking in recv forever
+        sock.close()
+        for conn in waiting_conns:
+            conn.close()
+
+
+def eval_server_main(args: Dict[str, Any], argv: List[str], port: Optional[int] = None) -> None:
+    """`main.py --eval-server [NUM_GAMES]` (evaluation.py:407-421)."""
+    import threading
+
+    env_args = args["env_args"]
+    prepare_env(env_args)
+    master_env = make_env(env_args)
+    num_games = int(argv[0]) if argv else 100
+    port = port or int(args["train_args"].get("battle_port", BATTLE_PORT))
+
+    print("network match server mode")
+    total: Dict[Any, int] = {}
+    lock = threading.Lock()
+    threads: List[threading.Thread] = []
+
+    def run_match(game: int, conns: List[FramedConnection]) -> None:
+        env = make_env(env_args)
+        agents = {p: NetworkAgent(conn) for p, conn in zip(env.players(), conns)}
+        outcome = exec_network_match(env, agents)
+        if outcome is not None:
+            o = outcome[env.players()[0]]
+            with lock:
+                total[o] = total.get(o, 0) + 1
+            print("game %d: outcome = %s" % (game, outcome))
+        for conn in conns:
+            try:
+                conn.send(("quit", None))
+            except OSError:
+                pass
+            conn.close()
+
+    groups = network_match_acception(num_games, env_args, len(master_env.players()), port)
+    for game, conns in enumerate(groups):
+        t = threading.Thread(target=run_match, args=(game, conns))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    print("total = %.3f (%d)" % (wp_func(total), sum(total.values())))
+
+
+def eval_client_main(args: Dict[str, Any], argv: List[str], port: Optional[int] = None) -> None:
+    """`main.py --eval-client AGENT [HOST] [N_GAMES]` (evaluation.py:424-436)."""
+    import time
+
+    print("network match client mode")
+    host = argv[1] if len(argv) >= 2 else "localhost"
+    port = port or int(args["train_args"].get("battle_port", BATTLE_PORT))
+    connected_once = False
+    boot_deadline = time.monotonic() + 60.0
+    while True:
+        try:
+            conn = connect_socket_connection(host, port)
+            connected_once = True
+        except OSError:
+            if not connected_once and time.monotonic() < boot_deadline:
+                time.sleep(0.5)  # server may still be booting
+                continue
+            print("server is gone")
+            return
+        try:
+            env_args = conn.recv()
+        except (OSError, ConnectionResetError, EOFError):
+            conn.close()
+            print("server is gone")
+            return
+
+        prepare_env(env_args)
+        env = make_env(env_args)
+        agent = build_agent(argv[0] if argv else "random", env)
+        if agent is None:
+            agent = load_model_agent(argv[0], env)
+        NetworkAgentClient(agent, env, conn).run()
+        conn.close()
+        if len(argv) >= 3 and argv[2] == "once":
+            return

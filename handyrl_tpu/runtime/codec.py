@@ -1,0 +1,155 @@
+"""Pickle-free binary wire codec for the distributed actor plane.
+
+The reference moves pickled python objects between machines
+(handyrl/connection.py:45-69) — including pickled ``nn.Module``s, i.e.
+code-execution-by-pickle between trusted nodes (SURVEY.md §2.5).  Here the
+wire vocabulary is closed: None/bool/int/float/str/bytes/list/tuple/dict
+(any encodable keys) and numpy arrays (raw buffer + dtype/shape header, no
+object dtypes).  Model parameters travel as flax-msgpack byte blobs
+(runtime/checkpoint.py:35-40), never as code.
+
+Format: one tag byte per value, big-endian fixed-width lengths.  Arrays
+are C-contiguous raw buffers, so encode/decode is O(bytes) memcpy — the
+host-side framing never touches the device path.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+import numpy as np
+
+_U32 = struct.Struct("!I")
+_I64 = struct.Struct("!q")
+_F64 = struct.Struct("!d")
+
+
+class CodecError(ValueError):
+    pass
+
+
+def _encode(obj: Any, out: list) -> None:
+    if obj is None:
+        out.append(b"N")
+    elif obj is True:
+        out.append(b"T")
+    elif obj is False:
+        out.append(b"F")
+    elif isinstance(obj, int):
+        out.append(b"i")
+        try:
+            out.append(_I64.pack(obj))
+        except struct.error as exc:
+            raise CodecError(f"int out of i64 range: {obj}") from exc
+    elif isinstance(obj, float):
+        out.append(b"f")
+        out.append(_F64.pack(obj))
+    elif isinstance(obj, str):
+        raw = obj.encode("utf-8")
+        out.append(b"s")
+        out.append(_U32.pack(len(raw)))
+        out.append(raw)
+    elif isinstance(obj, (bytes, bytearray, memoryview)):
+        raw = bytes(obj)
+        out.append(b"b")
+        out.append(_U32.pack(len(raw)))
+        out.append(raw)
+    elif isinstance(obj, np.ndarray):
+        if obj.dtype.hasobject:
+            raise CodecError("object-dtype arrays are not wire-encodable")
+        shape = obj.shape  # before ascontiguousarray, which promotes 0-d to 1-d
+        arr = np.ascontiguousarray(obj)
+        dt = arr.dtype.str.encode("ascii")
+        out.append(b"a")
+        out.append(_U32.pack(len(dt)))
+        out.append(dt)
+        out.append(_U32.pack(len(shape)))
+        for d in shape:
+            out.append(_U32.pack(d))
+        raw = arr.tobytes()
+        out.append(_U32.pack(len(raw)))
+        out.append(raw)
+    elif isinstance(obj, (np.bool_, np.integer, np.floating)):
+        _encode(obj.item(), out)
+    elif isinstance(obj, list):
+        out.append(b"l")
+        out.append(_U32.pack(len(obj)))
+        for item in obj:
+            _encode(item, out)
+    elif isinstance(obj, tuple):
+        out.append(b"t")
+        out.append(_U32.pack(len(obj)))
+        for item in obj:
+            _encode(item, out)
+    elif isinstance(obj, dict):
+        out.append(b"d")
+        out.append(_U32.pack(len(obj)))
+        for key, value in obj.items():
+            _encode(key, out)
+            _encode(value, out)
+    else:
+        raise CodecError(f"type {type(obj).__name__} is not wire-encodable")
+
+
+def dumps(obj: Any) -> bytes:
+    out: list = []
+    _encode(obj, out)
+    return b"".join(out)
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        end = self.pos + n
+        if end > len(self.buf):
+            raise CodecError("truncated message")
+        raw = self.buf[self.pos : end]
+        self.pos = end
+        return raw
+
+    def u32(self) -> int:
+        return _U32.unpack(self.take(4))[0]
+
+
+def _decode(r: _Reader) -> Any:
+    tag = r.take(1)
+    if tag == b"N":
+        return None
+    if tag == b"T":
+        return True
+    if tag == b"F":
+        return False
+    if tag == b"i":
+        return _I64.unpack(r.take(8))[0]
+    if tag == b"f":
+        return _F64.unpack(r.take(8))[0]
+    if tag == b"s":
+        return r.take(r.u32()).decode("utf-8")
+    if tag == b"b":
+        return r.take(r.u32())
+    if tag == b"a":
+        dt = np.dtype(r.take(r.u32()).decode("ascii"))
+        shape = tuple(r.u32() for _ in range(r.u32()))
+        raw = r.take(r.u32())
+        return np.frombuffer(raw, dtype=dt).reshape(shape).copy()
+    if tag == b"l":
+        return [_decode(r) for _ in range(r.u32())]
+    if tag == b"t":
+        return tuple(_decode(r) for _ in range(r.u32()))
+    if tag == b"d":
+        return {_decode(r): _decode(r) for _ in range(r.u32())}
+    raise CodecError(f"unknown tag {tag!r}")
+
+
+def loads(buf: bytes) -> Any:
+    r = _Reader(bytes(buf))
+    obj = _decode(r)
+    if r.pos != len(r.buf):
+        raise CodecError("trailing bytes after message")
+    return obj

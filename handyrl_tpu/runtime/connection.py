@@ -1,0 +1,183 @@
+"""Host-level transport for the distributed actor plane.
+
+Capability parity with reference handyrl/connection.py: length-prefixed
+framing (connection.py:20-69), ``send_recv`` RPC (14-17), socket helpers
+(72-114), and the ``QueueCommunicator`` async hub (176-224).  Differences:
+
+* Frames carry the pickle-free codec (runtime/codec.py), not pickle.
+* This layer only moves *actor-plane* traffic (job args, episodes, eval
+  results, param blobs).  The gradient/param plane inside the learner is
+  XLA collectives over ICI/DCN (parallel/train_step.py) and never touches
+  these sockets — the two planes the reference conflates are split by
+  design (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import io
+import queue
+import socket
+import struct
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from . import codec
+
+_HEADER = struct.Struct("!I")
+
+
+class FramedConnection:
+    """u32-length-prefixed codec frames over a stream socket."""
+
+    def __init__(self, conn: socket.socket):
+        self.conn = conn
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+
+    def fileno(self) -> int:
+        return self.conn.fileno()
+
+    def close(self) -> None:
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = io.BytesIO()
+        while buf.tell() < n:
+            chunk = self.conn.recv(n - buf.tell())
+            if not chunk:
+                raise ConnectionResetError("connection closed mid-frame")
+            buf.write(chunk)
+        return buf.getvalue()
+
+    def recv(self) -> Any:
+        with self._recv_lock:
+            (length,) = _HEADER.unpack(self._recv_exact(4))
+            payload = self._recv_exact(length) if length else b""
+        return codec.loads(payload)
+
+    def send(self, obj: Any) -> None:
+        payload = codec.dumps(obj)
+        with self._send_lock:
+            self.conn.sendall(_HEADER.pack(len(payload)) + payload)
+
+
+def send_recv(conn: FramedConnection, sdata: Any) -> Any:
+    conn.send(sdata)
+    return conn.recv()
+
+
+def open_socket_connection(port: int, reuse: bool = True) -> socket.socket:
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1 if reuse else 0)
+    sock.bind(("", int(port)))
+    return sock
+
+
+def accept_socket_connections(
+    port: Optional[int] = None,
+    timeout: Optional[float] = None,
+    maxsize: int = 1024,
+    sock: Optional[socket.socket] = None,
+) -> Iterator[Optional[FramedConnection]]:
+    """Yield accepted FramedConnections (None on timeout), up to maxsize."""
+    if sock is None:
+        sock = open_socket_connection(port)
+    sock.listen(maxsize)
+    sock.settimeout(timeout)
+    count = 0
+    while count < maxsize:
+        try:
+            conn, _ = sock.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            yield FramedConnection(conn)
+            count += 1
+        except socket.timeout:
+            yield None
+        except OSError:
+            return
+
+
+def connect_socket_connection(host: str, port: int, timeout: float = 32.0) -> FramedConnection:
+    sock = socket.create_connection((host, int(port)), timeout=timeout)
+    sock.settimeout(None)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return FramedConnection(sock)
+
+
+class QueueCommunicator:
+    """Async fan-in hub over many connections (connection.py:176-224).
+
+    Daemon send/recv threads multiplex the registered connections through
+    bounded queues; connections are dropped silently on reset/EOF, matching
+    the reference's join-only elasticity (workers may come and go, the
+    server never tracks them individually).
+    """
+
+    def __init__(self, conns: Optional[List[FramedConnection]] = None):
+        self.input_queue: "queue.Queue[Tuple[FramedConnection, Any]]" = queue.Queue(maxsize=256)
+        self.output_queue: "queue.Queue[Tuple[FramedConnection, Any]]" = queue.Queue(maxsize=256)
+        self.conns: Dict[FramedConnection, threading.Thread] = {}
+        self._lock = threading.Lock()
+        self.shutdown_flag = False
+        for conn in conns or []:
+            self.add_connection(conn)
+        self._send_thread = threading.Thread(target=self._send_loop, daemon=True)
+        self._send_thread.start()
+
+    def connection_count(self) -> int:
+        with self._lock:
+            return len(self.conns)
+
+    def recv(self, timeout: Optional[float] = None) -> Tuple[FramedConnection, Any]:
+        return self.input_queue.get(timeout=timeout)
+
+    def send(self, conn: FramedConnection, send_data: Any) -> None:
+        self.output_queue.put((conn, send_data))
+
+    def shutdown(self) -> None:
+        self.shutdown_flag = True
+        with self._lock:
+            conns = list(self.conns)
+        for conn in conns:
+            self.disconnect(conn)
+
+    def add_connection(self, conn: FramedConnection) -> None:
+        # one receiver thread per connection: blocking recv() needs no
+        # select() dance and each frame lands on input_queue in order
+        t = threading.Thread(target=self._recv_loop, args=(conn,), daemon=True)
+        with self._lock:
+            self.conns[conn] = t
+        t.start()
+
+    def disconnect(self, conn: FramedConnection) -> None:
+        with self._lock:
+            self.conns.pop(conn, None)
+        conn.close()
+
+    def _recv_loop(self, conn: FramedConnection) -> None:
+        while not self.shutdown_flag:
+            try:
+                data = conn.recv()
+            except (ConnectionResetError, BrokenPipeError, EOFError, OSError, codec.CodecError):
+                self.disconnect(conn)
+                return
+            with self._lock:
+                if conn not in self.conns:
+                    return
+            self.input_queue.put((conn, data))
+
+    def _send_loop(self) -> None:
+        while True:
+            conn, data = self.output_queue.get()
+            try:
+                conn.send(data)
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                self.disconnect(conn)
+            except Exception as exc:
+                # e.g. CodecError on an unencodable reply: drop that peer but
+                # never kill the hub's only send thread (all peers would hang)
+                print("send failed, dropping connection:", exc)
+                self.disconnect(conn)

@@ -171,7 +171,12 @@ def evaluate_mp(env_args: Dict[str, Any], agents: Dict[int, Any], num_games: int
     job_iter = iter(jobs)
 
     def run():
+        import copy
+
         env = make_env(env_args)
+        # per-thread shallow clones: models are shared (thread-safe jitted
+        # apply) but Agent.hidden is per-game state and must not be raced
+        local_agents = {k: copy.copy(a) for k, a in agents.items()}
         while True:
             with lock:
                 job = next(job_iter, None)
@@ -179,7 +184,7 @@ def evaluate_mp(env_args: Dict[str, Any], agents: Dict[int, Any], num_games: int
                 return
             _, pat = job
             # pattern maps seat -> agent key; agents keyed by original order
-            seat_agents = {seat: agents[pat[idx]] for idx, seat in enumerate(env.players())}
+            seat_agents = {seat: local_agents[pat[idx]] for idx, seat in enumerate(env.players())}
             outcome = exec_match(env, seat_agents)
             if outcome is None:
                 continue

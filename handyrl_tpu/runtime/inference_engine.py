@@ -28,6 +28,10 @@ import numpy as np
 from ..utils import tree_map, tree_stack
 
 
+class EngineStopped(RuntimeError):
+    """Raised to waiters when the engine is stopped with requests pending."""
+
+
 def _next_bucket(n: int, max_batch: int) -> int:
     b = 1
     while b < n:
@@ -72,6 +76,14 @@ class BatchedInferenceEngine:
     def stop(self) -> None:
         self._stop.set()
         self._queue.put(None)
+        # fail any requests that raced past the serve loop's exit
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None and not item[2].done():
+                item[2].set_exception(EngineStopped("inference engine stopped"))
 
     def update_model(self, model) -> None:
         """Swap in new variables (same module); takes effect next batch."""
@@ -87,7 +99,12 @@ class BatchedInferenceEngine:
 
     def submit(self, obs, hidden=None) -> Future:
         fut: Future = Future()
+        if self._stop.is_set():
+            fut.set_exception(EngineStopped("inference engine stopped"))
+            return fut
         self._queue.put((obs, hidden, fut))
+        if self._stop.is_set():  # raced with stop(): don't strand the waiter
+            self.stop()
         return fut
 
     # -- dispatcher ---------------------------------------------------------

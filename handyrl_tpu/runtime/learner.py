@@ -81,6 +81,7 @@ class Learner:
         self.model_server = LocalModelServer(self.module, make_env(args["env_args"]), self.args)
         self.model_server.publish(self.model_epoch, params)
 
+        self.remote = remote
         if remote:
             from .server import WorkerServer  # noqa: avoid socket deps locally
 
@@ -90,6 +91,7 @@ class Learner:
 
         self._requests: queue.Queue = queue.Queue()
         self._active_workers = 0
+        self._shutdown_t0 = 0.0
         self._epoch_t0 = time.time()
         self._epoch_steps0 = 0
         self._epoch_episodes0 = 0
@@ -220,23 +222,37 @@ class Learner:
             self.num_episodes += 1
         return args
 
+    def _workers_active(self) -> bool:
+        """Drain condition: remote counts live connections, local counts threads."""
+        if self.remote:
+            if self._shutdown_t0 and time.time() - self._shutdown_t0 > 30.0:
+                return False  # grace period for lingering connections
+            return self.worker.connection_count() > 0
+        return self._active_workers > 0
+
     def server(self) -> None:
         print("started server")
         prev_update_episodes = self.args["minimum_episodes"]
         next_update_episodes = prev_update_episodes + self.args["update_episodes"]
+        self._shutdown_t0 = 0.0
 
-        while self._active_workers > 0 or not self.shutdown_flag:
+        while self._workers_active() or not self.shutdown_flag:
+            if self.shutdown_flag and not self._shutdown_t0:
+                self._shutdown_t0 = time.time()
             try:
                 req, data, fut = self._requests.get(timeout=0.3)
             except queue.Empty:
                 continue
 
             if req == "args":
+                # data None: one local worker; int n: a gather prefetching n
                 if self.shutdown_flag:
                     fut.set_result(None)
                     self._active_workers -= 1
-                else:
+                elif data is None:
                     fut.set_result(self._assign_role())
+                else:
+                    fut.set_result([self._assign_role() for _ in range(int(data))])
             elif req == "episode":
                 self.feed_episodes([data] if not isinstance(data, list) else data)
                 fut.set_result(None)

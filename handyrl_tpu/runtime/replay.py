@@ -16,7 +16,6 @@ Design vs the reference (train.py:271-319, generation.py:84-91):
 
 from __future__ import annotations
 
-import pickle
 import random
 import threading
 import zlib
@@ -25,6 +24,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from . import codec
+
 try:
     import psutil
 except ImportError:  # pragma: no cover
@@ -32,11 +33,13 @@ except ImportError:  # pragma: no cover
 
 
 def compress_block(columns: Dict[str, Any]) -> bytes:
-    return zlib.compress(pickle.dumps(columns, protocol=pickle.HIGHEST_PROTOCOL), level=1)
+    # codec, not pickle: blocks travel the wire from remote workers and are
+    # decoded on the learner — they must never carry executable payloads
+    return zlib.compress(codec.dumps(columns), level=1)
 
 
 def decompress_block(blob: bytes) -> Dict[str, Any]:
-    return pickle.loads(zlib.decompress(blob))
+    return codec.loads(zlib.decompress(blob))
 
 
 class EpisodeStore:

@@ -1,0 +1,339 @@
+"""Remote worker machines over TCP: server side and worker side.
+
+Topology parity with reference handyrl/worker.py:192-271: an entry
+listener hands joining machines the full training config plus a
+``base_worker_id`` (worker.py:199-213); each machine then opens data
+connections that carry job args, episodes, eval results and model blobs.
+Two-level aggregation is kept — a machine multiplexes its actors over
+``num_gathers`` connections (one per ~16 actors, worker.py:110-124) so the
+server's connection count stays O(gathers), not O(actors).
+
+TPU-first differences:
+
+* Actors on a worker machine are threads sharing one
+  ``BatchedInferenceEngine`` (cross-env batched inference), not
+  process-per-actor batch-1 inference.
+* Model parameters travel as flax-msgpack byte blobs, decoded into the
+  machine's local engine — never pickled module code (SURVEY.md §2.5).
+* A gather prefetches job assignments in bulk and flushes episode/result
+  uploads in bulk (worker.py:136-168 semantics) to amortize WAN RTT.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..envs import make_env, prepare_env
+from ..models import InferenceModel, RandomModel, init_variables
+from .checkpoint import load_params, model_path, params_from_bytes, params_to_bytes
+from .connection import (
+    FramedConnection,
+    QueueCommunicator,
+    accept_socket_connections,
+    connect_socket_connection,
+    send_recv,
+)
+from .inference_engine import BatchedInferenceEngine
+from .worker import Worker
+
+ENTRY_PORT = 9999
+DATA_PORT = 9998
+
+
+# ---------------------------------------------------------------------------
+# learner side
+# ---------------------------------------------------------------------------
+
+
+class WorkerServer(QueueCommunicator):
+    """Serves remote worker machines (reference WorkerServer, worker.py:192-224).
+
+    Same ``run()`` surface as LocalWorkerPool so the Learner treats local
+    and remote actor planes identically: requests are dispatched to the
+    learner's ``handler`` callable; ``model`` requests are answered here
+    from the model server (bytes), without a round-trip through the
+    learner loop.
+    """
+
+    def __init__(self, args: Dict[str, Any], handler: Callable, model_server):
+        super().__init__()
+        self.args = args
+        self.handler = handler
+        self.model_server = model_server
+        self.entry_port = int(args["worker"].get("entry_port", ENTRY_PORT))
+        self.data_port = int(args["worker"].get("data_port", DATA_PORT))
+        self.total_worker_count = 0
+        self._threads: List[threading.Thread] = []
+
+    def run(self) -> None:
+        for target in (self._entry_server, self._data_server, self._dispatch):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _entry_server(self) -> None:
+        print("started entry server %d" % self.entry_port)
+        for conn in accept_socket_connections(port=self.entry_port, timeout=0.5):
+            if conn is None:
+                if self.shutdown_flag:
+                    break
+                continue
+            try:
+                worker_args = conn.recv()
+                n = int(worker_args.get("num_parallel", 8))
+                reply = {
+                    "env_args": self.args["env"],
+                    "train_args": {k: v for k, v in self.args.items() if k != "env"},
+                    "worker_args": dict(worker_args, base_worker_id=self.total_worker_count),
+                }
+                self.total_worker_count += n
+                conn.send(reply)
+            except Exception as exc:
+                print("entry handshake failed:", exc)
+            finally:
+                conn.close()
+        print("finished entry server")
+
+    def _data_server(self) -> None:
+        print("started worker server %d" % self.data_port)
+        for conn in accept_socket_connections(port=self.data_port, timeout=0.5):
+            if conn is None:
+                if self.shutdown_flag:
+                    break
+                continue
+            self.add_connection(conn)
+        print("finished worker server")
+
+    def _dispatch(self) -> None:
+        import queue as _queue
+
+        while not self.shutdown_flag:
+            try:
+                conn, (req, data) = self.recv(timeout=0.3)
+            except _queue.Empty:
+                continue
+            except (TypeError, ValueError):
+                continue
+            if req == "model":
+                self.send(conn, self._model_bytes(int(data)))
+            else:
+                self.send(conn, self.handler(req, data))
+
+    def _model_bytes(self, requested_id: int):
+        """(model_id, params_blob) for a snapshot id (train.py:604-614)."""
+        latest_id = self.model_server.model_id
+        if 0 < requested_id < latest_id:
+            try:
+                params = load_params(
+                    model_path(self.model_server.model_dir, requested_id),
+                    self.model_server.latest_params(),
+                )
+                return requested_id, params_to_bytes(params)
+            except Exception:
+                pass  # fall back to latest (reference train.py:608-613)
+        return latest_id, params_to_bytes(self.model_server.latest_params())
+
+
+# ---------------------------------------------------------------------------
+# worker machine side
+# ---------------------------------------------------------------------------
+
+
+class RemoteModelServer:
+    """Machine-local model cache fed by ('model', id) RPCs (worker.py:43-64).
+
+    The newest params live behind the shared BatchedInferenceEngine; id 0
+    is the zero-output RandomModel; stale ids resolve to standalone
+    InferenceModels fetched once and cached.
+    """
+
+    def __init__(self, module, env, args: Dict[str, Any], fetch: Callable[[int], tuple]):
+        self.module = module
+        self._fetch = fetch
+        variables = init_variables(module, env)
+        self._template = variables["params"]
+        self._model = InferenceModel(module, variables)
+        env.reset()
+        self._random = RandomModel.from_model(self._model, env.observation(env.players()[0]))
+        self.engine = BatchedInferenceEngine(
+            self._model, max_batch=args.get("inference_batch_size", 64)
+        ).start()
+        self.model_id = -1
+        self._cache: Dict[int, InferenceModel] = {}
+        self._lock = threading.Lock()
+        # seed the engine with the learner's actual latest params — without
+        # this, jobs with model_id -1 would run on local random-init weights
+        # until the first concrete-epoch fetch (a whole epoch at join time)
+        got_id, blob = self._fetch(-1)
+        self.model_id = got_id
+        self.engine.update_model(
+            InferenceModel(self.module, {"params": params_from_bytes(self._template, blob)})
+        )
+
+    def get(self, model_id: int):
+        if model_id == 0:
+            return self._random
+        with self._lock:
+            current = self.model_id
+            if model_id < 0 or model_id == current:
+                return self.engine.client()
+            cached = self._cache.get(model_id)
+        if cached is not None:
+            return cached
+        got_id, blob = self._fetch(model_id)
+        params = params_from_bytes(self._template, blob)
+        model = InferenceModel(self.module, {"params": params})
+        with self._lock:
+            if got_id > self.model_id:
+                self.model_id = got_id
+                self.engine.update_model(model)
+                # drop stale snapshots; only explicitly-pinned old ids recur
+                self._cache = {k: v for k, v in self._cache.items() if k == model_id}
+            if got_id != model_id:
+                # server substituted latest for a missing snapshot
+                return self.engine.client() if got_id == self.model_id else model
+            if model_id != self.model_id:
+                self._cache[model_id] = model
+        return self.engine.client() if model_id == self.model_id else model
+
+    def stop(self) -> None:
+        self.engine.stop()
+
+
+class RemoteGather:
+    """One data connection multiplexing ~16 actor threads (worker.py:99-173).
+
+    Prefetches job args in blocks and flushes episode/result uploads in
+    blocks; all RPCs are serialized on the single connection.
+    """
+
+    def __init__(self, conn: FramedConnection, n_workers: int):
+        self.conn = conn
+        self.buffer_length = 1 + n_workers // 4
+        self._lock = threading.Lock()
+        self._args_queue: List[Any] = []
+        self._uploads: Dict[str, List[Any]] = {"episode": [], "result": []}
+        self.closed = False
+
+    def __call__(self, req: str, data: Any) -> Any:
+        with self._lock:
+            if req == "args":
+                return self._next_args()
+            if req in self._uploads:
+                self._uploads[req].append(data)
+                if len(self._uploads[req]) >= self.buffer_length:
+                    self._flush(req)
+                return None
+            if self.closed:
+                return None
+            return send_recv(self.conn, (req, data))
+
+    def _next_args(self) -> Optional[Dict[str, Any]]:
+        if self.closed:
+            return None
+        if not self._args_queue:
+            for req in ("episode", "result"):
+                self._flush(req)  # don't let uploads sit behind idle prefetch
+            batch = send_recv(self.conn, ("args", self.buffer_length))
+            if batch is None:
+                self.close()
+                return None
+            self._args_queue = [a for a in batch if a is not None]
+            if not self._args_queue:
+                self.close()
+                return None
+        return self._args_queue.pop(0)
+
+    def _flush(self, req: str) -> None:
+        if self._uploads[req] and not self.closed:
+            send_recv(self.conn, (req, self._uploads[req]))
+            self._uploads[req] = []
+
+    def fetch_model(self, model_id: int) -> tuple:
+        with self._lock:
+            if self.closed:
+                raise ConnectionResetError("gather connection closed")
+            return send_recv(self.conn, ("model", model_id))
+
+    def close(self) -> None:
+        if not self.closed:
+            for req in ("episode", "result"):
+                try:
+                    self._flush(req)
+                except OSError:
+                    pass
+            self.closed = True
+            self.conn.close()
+
+
+class RemoteWorkerCluster:
+    """Worker-machine main (reference RemoteWorkerCluster, worker.py:235-261)."""
+
+    def __init__(self, worker_args: Dict[str, Any]):
+        self.worker_args = dict(worker_args)
+        self.server_address = worker_args["server_address"]
+        self.entry_port = int(worker_args.get("entry_port", ENTRY_PORT))
+        self.num_parallel = int(worker_args.get("num_parallel", 8))
+
+    def _entry(self, retry_seconds: float = 60.0) -> Dict[str, Any]:
+        deadline = time.monotonic() + retry_seconds
+        while True:
+            try:
+                conn = connect_socket_connection(self.server_address, self.entry_port)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.5)  # server may still be booting; keep knocking
+        try:
+            return send_recv(conn, dict(self.worker_args, num_parallel=self.num_parallel))
+        finally:
+            conn.close()
+
+    def run(self) -> None:
+        cfg = self._entry()
+        args = dict(cfg["train_args"])
+        args["env"] = cfg["env_args"]
+        base_worker_id = cfg["worker_args"].get("base_worker_id", 0)
+        data_port = int(args["worker"].get("data_port", DATA_PORT))
+        prepare_env(args["env"])
+
+        num_gathers = 1 + (self.num_parallel - 1) // 16
+        gathers: List[RemoteGather] = []
+        shares: List[int] = []
+        for g in range(num_gathers):
+            share = self.num_parallel // num_gathers + int(g < self.num_parallel % num_gathers)
+            conn = connect_socket_connection(self.server_address, data_port)
+            gathers.append(RemoteGather(conn, share))
+            shares.append(share)
+
+        model_server = RemoteModelServer(
+            make_env(args["env"]).net(), make_env(args["env"]), args, gathers[0].fetch_model
+        )
+
+        threads: List[threading.Thread] = []
+        wid = base_worker_id
+        for gather, share in zip(gathers, shares):
+            for _ in range(share):
+                worker = Worker(make_env(args["env"]), args, gather, model_server, wid)
+                t = threading.Thread(target=worker.run, daemon=True, name=f"remote-actor-{wid}")
+                t.start()
+                threads.append(t)
+                wid += 1
+        try:
+            for t in threads:
+                t.join()
+        finally:
+            for gather in gathers:
+                gather.close()
+            model_server.stop()
+
+
+def worker_main(args: Dict[str, Any], argv: Optional[List[str]] = None) -> None:
+    """`main.py --worker [NUM_PARALLEL]` (reference worker.py:264-271)."""
+    worker_args = dict(args["worker_args"])
+    if argv and len(argv) >= 3:
+        worker_args["num_parallel"] = int(argv[2])
+    RemoteWorkerCluster(worker_args).run()

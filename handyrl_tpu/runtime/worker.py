@@ -96,18 +96,23 @@ class Worker:
         return {p: self.model_server.get(mid) for p, mid in model_ids.items()}
 
     def run(self) -> None:
+        from .inference_engine import EngineStopped
+
         while True:
             args = self.conn("args", None)
             if args is None:
                 break
             role = args["role"]
-            models = self._gather_models(args["model_id"])
-            if role == "g":
-                episode = self.generator.execute(models, args)
-                self.conn("episode", episode)
-            elif role == "e":
-                result = self.evaluator.execute(models, args)
-                self.conn("result", result)
+            try:
+                models = self._gather_models(args["model_id"])
+                if role == "g":
+                    episode = self.generator.execute(models, args)
+                    self.conn("episode", episode)
+                elif role == "e":
+                    result = self.evaluator.execute(models, args)
+                    self.conn("result", result)
+            except EngineStopped:
+                break  # learner shut the engine down mid-job; drain quietly
 
 
 class LocalWorkerPool:

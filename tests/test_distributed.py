@@ -1,0 +1,246 @@
+"""Distributed actor plane tests: codec, framing, TCP workers, battle mode.
+
+These exercise the multi-node surface the reference validates only
+implicitly (SURVEY.md §4: the delta-sync replica test is the reference's
+sole multi-node surrogate): the pickle-free wire codec, framed RPC over
+real sockets, a full --train-server/--worker run on localhost, and the
+network battle mode.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.config import normalize_args
+from handyrl_tpu.runtime import codec
+from handyrl_tpu.runtime.connection import (
+    FramedConnection,
+    QueueCommunicator,
+    accept_socket_connections,
+    connect_socket_connection,
+    send_recv,
+)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def connect_retry(host: str, port: int, attempts: int = 50) -> FramedConnection:
+    import time
+
+    for i in range(attempts):
+        try:
+            return connect_socket_connection(host, port)
+        except OSError:
+            time.sleep(0.1)
+    raise ConnectionRefusedError(f"could not reach {host}:{port}")
+
+
+# -- codec ------------------------------------------------------------------
+
+
+def test_codec_roundtrip_scalars_and_containers():
+    samples = [
+        None,
+        True,
+        False,
+        0,
+        -(2**40),
+        3.5,
+        "hello ∑",
+        b"\x00\xffbytes",
+        [1, [2, "x"], None],
+        (1, 2.5, "t"),
+        {"a": 1, 0: "int-key", 1: {"nested": b"ok"}},
+    ]
+    for obj in samples:
+        assert codec.loads(codec.dumps(obj)) == obj
+
+
+def test_codec_roundtrip_numpy():
+    arrays = [
+        np.arange(12, dtype=np.int32).reshape(3, 4),
+        np.random.randn(2, 3, 5).astype(np.float32),
+        np.array(True),
+        np.zeros((0, 7), np.float64),
+    ]
+    for arr in arrays:
+        out = codec.loads(codec.dumps(arr))
+        assert out.dtype == arr.dtype and out.shape == arr.shape
+        np.testing.assert_array_equal(out, arr)
+    # numpy scalars decay to python scalars
+    assert codec.loads(codec.dumps(np.float32(2.5))) == 2.5
+    assert codec.loads(codec.dumps(np.int64(7))) == 7
+
+
+def test_codec_roundtrip_episode_like():
+    episode = {
+        "args": {"role": "g", "player": [0, 1], "model_id": {0: 3, 1: -1}},
+        "steps": 9,
+        "players": [0, 1],
+        "outcome": {0: 1.0, 1: -1.0},
+        "blocks": [b"compressed-block-1", b"compressed-block-2"],
+    }
+    assert codec.loads(codec.dumps(episode)) == episode
+
+
+def test_codec_rejects_unencodable():
+    with pytest.raises(codec.CodecError):
+        codec.dumps(object())
+    with pytest.raises(codec.CodecError):
+        codec.dumps(np.array([object()]))
+    with pytest.raises(codec.CodecError):
+        codec.loads(codec.dumps([1, 2]) + b"junk")
+
+
+# -- framing + RPC over real sockets ---------------------------------------
+
+
+def test_framed_send_recv_over_socket():
+    port = free_port()
+    server_obj = {"reply": np.ones((4, 4), np.float32), "n": 1}
+    got = {}
+
+    def server():
+        for conn in accept_socket_connections(port=port, maxsize=1):
+            got["req"] = conn.recv()
+            conn.send(server_obj)
+            conn.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    conn = connect_retry("localhost", port)
+    reply = send_recv(conn, ("args", None))
+    conn.close()
+    t.join(timeout=5)
+
+    assert got["req"] == ("args", None)
+    assert reply["n"] == 1
+    np.testing.assert_array_equal(reply["reply"], np.ones((4, 4), np.float32))
+
+
+def test_queue_communicator_echo():
+    port = free_port()
+    hub_box = {}
+
+    def server():
+        hub = QueueCommunicator()
+        hub_box["hub"] = hub
+        for conn in accept_socket_connections(port=port, maxsize=2):
+            hub.add_connection(conn)
+            break
+        for _ in range(3):
+            conn, data = hub.recv(timeout=5)
+            hub.send(conn, ("echo", data))
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    conn = connect_retry("localhost", port)
+    for i in range(3):
+        assert send_recv(conn, i) == ("echo", i)
+    conn.close()
+    t.join(timeout=5)
+    assert hub_box["hub"].connection_count() >= 0
+
+
+# -- full remote training over localhost TCP --------------------------------
+
+
+@pytest.mark.slow
+def test_train_server_with_remote_worker(tmp_path, monkeypatch):
+    import json
+    import os
+
+    from handyrl_tpu.runtime.learner import Learner
+    from handyrl_tpu.runtime.server import worker_main
+
+    monkeypatch.chdir(tmp_path)
+    entry_port, data_port = free_port(), free_port()
+    args = normalize_args(
+        {
+            "env_args": {"env": "TicTacToe"},
+            "train_args": {
+                "batch_size": 8,
+                "forward_steps": 4,
+                "minimum_episodes": 10,
+                "update_episodes": 12,
+                "maximum_episodes": 100,
+                "epochs": 2,
+                "num_batchers": 1,
+                "eval_rate": 0.2,
+                # 1-device mesh: this test exercises the TCP transport, not
+                # sharding (test_end_to_end_training covers the 8-dev mesh).
+                # On virtual CPU devices an 8-way all-reduce rendezvous can
+                # starve when the two inference engines (learner + remote
+                # machine, same process here) occupy the XLA CPU thread pool.
+                "mesh": {"dp": 1},
+                "worker": {"num_parallel": 2, "entry_port": entry_port, "data_port": data_port},
+            },
+            "worker_args": {
+                "server_address": "localhost",
+                "num_parallel": 2,
+                "entry_port": entry_port,
+            },
+        }
+    )
+
+    learner = Learner(args, remote=True)
+    learner_thread = threading.Thread(target=learner.run, daemon=True)
+    learner_thread.start()
+
+    worker_thread = threading.Thread(target=worker_main, args=(args,), daemon=True)
+    worker_thread.start()
+
+    learner_thread.join(timeout=300)
+    assert not learner_thread.is_alive(), "remote training did not finish"
+    worker_thread.join(timeout=30)
+
+    assert os.path.exists("models/latest.ckpt")
+    assert os.path.exists("models/2.ckpt")
+    records = [json.loads(l) for l in open("metrics.jsonl")]
+    assert len(records) >= 2
+    assert learner.num_returned_episodes >= 22
+
+
+# -- network battle mode ----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_network_battle_mode(capsys):
+    from handyrl_tpu.runtime.battle import eval_client_main, eval_server_main
+
+    port = free_port()
+    args = normalize_args({"env_args": {"env": "TicTacToe"}, "train_args": {}})
+
+    server = threading.Thread(
+        target=eval_server_main, args=(args, ["2"]), kwargs={"port": port}, daemon=True
+    )
+    server.start()
+
+    clients = [
+        threading.Thread(
+            target=eval_client_main,
+            args=(args, [spec, "localhost"]),
+            kwargs={"port": port},
+            daemon=True,
+        )
+        for spec in ("random", "random")
+    ]
+    for c in clients:
+        c.start()
+
+    server.join(timeout=120)
+    assert not server.is_alive(), "battle server did not finish"
+    for c in clients:
+        c.join(timeout=30)
+
+    out = capsys.readouterr().out
+    assert "total =" in out
+    assert "game 0" in out and "game 1" in out
