@@ -1,5 +1,6 @@
 from .nets import SimpleConvNet, GeeseNet, GeisterNet
 from .inference import InferenceModel, RandomModel, init_variables
+from .export import ExportedModel, export_model
 
 __all__ = [
     "SimpleConvNet",
@@ -8,4 +9,6 @@ __all__ = [
     "InferenceModel",
     "RandomModel",
     "init_variables",
+    "ExportedModel",
+    "export_model",
 ]

@@ -1,0 +1,109 @@
+"""Deployment export: serialized StableHLO artifacts with params baked in.
+
+TPU-native equivalent of the reference's ONNX path (scripts/
+make_onnx_model.py:28-58 export, evaluation.py:287-353 OnnxModel): a
+trained model is frozen into a single self-contained artifact that any
+JAX runtime can execute without the framework's model code, with a
+dynamic (symbolic) batch dimension like the reference's dynamic batch
+axis.  Hidden tensors ride along as an explicit pytree (the reference
+discovers them by the ``hidden*`` input-name prefix).
+
+Artifact format (our wire codec, runtime/codec.py):
+    {"mlir": <jax.export serialized bytes>, "hidden0": pytree|None,
+     "tree": <flattened output treedef repr>, "keys": [output names]}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import tree_map
+
+
+def _leaf_specs(pytree, scope, leading: str):
+    """ShapeDtypeStructs with a shared symbolic leading dim for every leaf."""
+
+    def spec(x):
+        x = np.asarray(x)
+        dims = ", ".join(str(d) for d in x.shape)
+        shape = jax.export.symbolic_shape(f"{leading}, {dims}" if dims else leading, scope=scope)
+        return jax.ShapeDtypeStruct(shape, x.dtype)
+
+    return tree_map(spec, pytree)
+
+
+def export_model(module, variables, sample_obs, path: str) -> None:
+    """Freeze (module, variables) into a serialized StableHLO file.
+
+    ``sample_obs`` is one unbatched observation pytree (from
+    ``env.observation(p)``); the exported callable takes batch-leading
+    pytrees with a symbolic batch size.
+    """
+    from ..runtime import codec
+
+    hidden0 = module.initial_state((1,))
+    scope = jax.export.SymbolicScope()
+    obs_spec = _leaf_specs(sample_obs, scope, "b")
+
+    # multi-platform lowering: the artifact must run wherever it's deployed
+    # (the reference's ONNX artifacts are platform-neutral; ours match)
+    platforms = ("cpu", "tpu")
+    if hidden0 is None:
+        fn = lambda obs: module.apply(variables, obs, None)  # noqa: E731
+        exported = jax.export.export(jax.jit(fn), platforms=platforms)(obs_spec)
+        hidden_host = None
+    else:
+        fn = lambda obs, hidden: module.apply(variables, obs, hidden)  # noqa: E731
+        hidden_spec = _leaf_specs(tree_map(lambda x: np.asarray(x)[0], hidden0), scope, "b")
+        exported = jax.export.export(jax.jit(fn), platforms=platforms)(obs_spec, hidden_spec)
+        hidden_host = tree_map(np.asarray, hidden0)
+
+    blob = codec.dumps({"mlir": exported.serialize(), "hidden0": hidden_host})
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+class ExportedModel:
+    """Inference over a serialized artifact; same API as InferenceModel.
+
+    Role of the reference's OnnxModel (evaluation.py:287-353): standalone
+    deployment/eval inference without the original model code.
+    """
+
+    def __init__(self, path: str):
+        from ..runtime import codec
+
+        with open(path, "rb") as f:
+            data = codec.loads(f.read())
+        self._exported = jax.export.deserialize(bytearray(data["mlir"]))
+        self._hidden0 = data["hidden0"]
+
+    def init_hidden(self, batch_dims=()):
+        if self._hidden0 is None:
+            return None
+        # stored with a leading batch axis of 1; strip it for per-sample use
+        flat = tree_map(lambda x: x[0], self._hidden0)
+        if not batch_dims:
+            return flat
+        return tree_map(lambda x: np.broadcast_to(x, tuple(batch_dims) + x.shape).copy(), flat)
+
+    def inference_batch(self, obs, hidden=None) -> Dict[str, Any]:
+        obs = tree_map(jnp.asarray, obs)
+        if self._hidden0 is None:
+            outputs = self._exported.call(obs)
+        else:
+            if hidden is None:
+                n = jax.tree_util.tree_leaves(obs)[0].shape[0]
+                hidden = self.init_hidden((n,))
+            outputs = self._exported.call(obs, tree_map(jnp.asarray, hidden))
+        return jax.device_get(outputs)
+
+    def inference(self, obs, hidden=None) -> Dict[str, Any]:
+        obs_b = tree_map(lambda x: np.asarray(x)[None], obs)
+        hidden_b = tree_map(lambda x: np.asarray(x)[None], hidden) if hidden is not None else None
+        outputs = self.inference_batch(obs_b, hidden_b)
+        return tree_map(lambda x: x[0], outputs)

@@ -162,21 +162,23 @@ def eval_server_main(args: Dict[str, Any], argv: List[str], port: Optional[int] 
 
 def eval_client_main(args: Dict[str, Any], argv: List[str], port: Optional[int] = None) -> None:
     """`main.py --eval-client AGENT [HOST] [N_GAMES]` (evaluation.py:424-436)."""
-    import time
-
     print("network match client mode")
     host = argv[1] if len(argv) >= 2 else "localhost"
     port = port or int(args["train_args"].get("battle_port", BATTLE_PORT))
+    max_games = None
+    if len(argv) >= 3:
+        max_games = 1 if argv[2] == "once" else int(argv[2])
+    games_played = 0
     connected_once = False
-    boot_deadline = time.monotonic() + 60.0
     while True:
         try:
-            conn = connect_socket_connection(host, port)
+            # retry while the server boots; after first contact, a refused
+            # connect means the server finished its games and went away
+            conn = connect_socket_connection(
+                host, port, retry_seconds=0.0 if connected_once else 60.0
+            )
             connected_once = True
         except OSError:
-            if not connected_once and time.monotonic() < boot_deadline:
-                time.sleep(0.5)  # server may still be booting
-                continue
             print("server is gone")
             return
         try:
@@ -193,5 +195,6 @@ def eval_client_main(args: Dict[str, Any], argv: List[str], port: Optional[int] 
             agent = load_model_agent(argv[0], env)
         NetworkAgentClient(agent, env, conn).run()
         conn.close()
-        if len(argv) >= 3 and argv[2] == "once":
+        games_played += 1
+        if max_games is not None and games_played >= max_games:
             return

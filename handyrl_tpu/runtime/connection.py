@@ -79,16 +79,21 @@ def open_socket_connection(port: int, reuse: bool = True) -> socket.socket:
 def accept_socket_connections(
     port: Optional[int] = None,
     timeout: Optional[float] = None,
-    maxsize: int = 1024,
+    maxsize: Optional[int] = None,
     sock: Optional[socket.socket] = None,
 ) -> Iterator[Optional[FramedConnection]]:
-    """Yield accepted FramedConnections (None on timeout), up to maxsize."""
+    """Yield accepted FramedConnections (None on timeout) until closed.
+
+    ``maxsize`` bounds the total accept count when given; the default is
+    unbounded — long-lived servers (elastic worker fleets, battle servers)
+    must never silently stop accepting.
+    """
     if sock is None:
         sock = open_socket_connection(port)
-    sock.listen(maxsize)
+    sock.listen(1024)
     sock.settimeout(timeout)
     count = 0
-    while count < maxsize:
+    while maxsize is None or count < maxsize:
         try:
             conn, _ = sock.accept()
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -100,8 +105,21 @@ def accept_socket_connections(
             return
 
 
-def connect_socket_connection(host: str, port: int, timeout: float = 32.0) -> FramedConnection:
-    sock = socket.create_connection((host, int(port)), timeout=timeout)
+def connect_socket_connection(
+    host: str, port: int, timeout: float = 32.0, retry_seconds: float = 0.0
+) -> FramedConnection:
+    """Connect, optionally retrying for ``retry_seconds`` (peer still booting)."""
+    import time
+
+    deadline = time.monotonic() + retry_seconds
+    while True:
+        try:
+            sock = socket.create_connection((host, int(port)), timeout=timeout)
+            break
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.5)
     sock.settimeout(None)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     return FramedConnection(sock)

@@ -104,7 +104,15 @@ def build_agent(raw: Any, env=None) -> Optional[Any]:
 
 
 def load_model_agent(model_path: str, env, module=None) -> Agent:
-    """Checkpoint path -> greedy Agent over a jitted InferenceModel."""
+    """Checkpoint (.ckpt) or exported StableHLO (.hlo) path -> greedy Agent.
+
+    Mirrors reference load_model dispatch (.pth vs .onnx,
+    evaluation.py:356-365); .hlo artifacts need no model code.
+    """
+    if model_path.endswith(".hlo"):
+        from ..models.export import ExportedModel
+
+        return Agent(ExportedModel(model_path))
     from ..models import init_variables
 
     module = module or env.net()
@@ -185,7 +193,12 @@ def evaluate_mp(env_args: Dict[str, Any], agents: Dict[int, Any], num_games: int
             _, pat = job
             # pattern maps seat -> agent key; agents keyed by original order
             seat_agents = {seat: local_agents[pat[idx]] for idx, seat in enumerate(env.players())}
-            outcome = exec_match(env, seat_agents)
+            try:
+                outcome = exec_match(env, seat_agents)
+            except Exception as exc:
+                # a broken agent/model must not silently zero the report
+                print(f"match failed: {type(exc).__name__}: {exc}")
+                continue
             if outcome is None:
                 continue
             # score from agent 0's perspective wherever it sat
@@ -220,6 +233,8 @@ def eval_main(args: Dict[str, Any], argv: List[str]) -> None:
     from ..envs import prepare_env
     from ..models import InferenceModel, init_variables
 
+    from .inference_engine import BatchedInferenceEngine
+
     env_args = args["env_args"]
     prepare_env(env_args)
     env = make_env(env_args)
@@ -227,6 +242,18 @@ def eval_main(args: Dict[str, Any], argv: List[str]) -> None:
     raw = argv[0] if argv else "models/latest.ckpt"
     num_games = int(argv[1]) if len(argv) >= 2 else 100
     num_workers = int(argv[2]) if len(argv) >= 3 else 4
+
+    # one batched engine per distinct model: eval threads submit through a
+    # single dispatcher, which batches inference across concurrent games
+    # (the TPU-first path — and a single device entry point)
+    engines: List[BatchedInferenceEngine] = []
+
+    def share(model):
+        if num_workers <= 1:
+            return model
+        engine = BatchedInferenceEngine(model, max_batch=max(8, num_workers)).start()
+        engines.append(engine)
+        return engine.client()
 
     def resolve(spec: str):
         agent = build_agent(spec, env)
@@ -237,11 +264,17 @@ def eval_main(args: Dict[str, Any], argv: List[str]) -> None:
             module = env.net()
             variables = init_variables(module, env)
             models = [
-                InferenceModel(module, {"params": load_params(p, variables["params"])})
+                share(InferenceModel(module, {"params": load_params(p, variables["params"])}))
                 for p in paths
             ]
             return EnsembleAgent(models)
-        return load_model_agent(spec, env)
+        agent = load_model_agent(spec, env)
+        agent.model = share(agent.model)
+        return agent
 
     agents = {0: resolve(raw), 1: build_agent("random", env) or RandomAgent()}
-    evaluate_mp(env_args, agents, num_games, num_workers)
+    try:
+        evaluate_mp(env_args, agents, num_games, num_workers)
+    finally:
+        for engine in engines:
+            engine.stop()

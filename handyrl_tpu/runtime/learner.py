@@ -171,12 +171,15 @@ class Learner:
             std = max(r2 / (n + 1e-6) - mean ** 2, 0.0) ** 0.5
             print("generation stats = %.3f +- %.3f" % (mean, std))
             record["generation_mean"] = mean
+            record["generation_std"] = std
 
         params, steps = self.trainer.update()
         if params is None:
             params = self.model_server.latest_params()
         self.update_model(params, steps)
 
+        if self.trainer.last_loss:
+            record["loss"] = dict(self.trainer.last_loss)
         now = time.time()
         record.update(
             steps=steps,

@@ -22,7 +22,6 @@ TPU-first differences:
 from __future__ import annotations
 
 import threading
-import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ..envs import make_env, prepare_env
@@ -66,6 +65,7 @@ class WorkerServer(QueueCommunicator):
         self.data_port = int(args["worker"].get("data_port", DATA_PORT))
         self.total_worker_count = 0
         self._threads: List[threading.Thread] = []
+        self._blob_cache: Dict[int, bytes] = {}
 
     def run(self) -> None:
         for target in (self._entry_server, self._data_server, self._dispatch):
@@ -122,18 +122,38 @@ class WorkerServer(QueueCommunicator):
                 self.send(conn, self.handler(req, data))
 
     def _model_bytes(self, requested_id: int):
-        """(model_id, params_blob) for a snapshot id (train.py:604-614)."""
+        """(model_id, params_blob) for a snapshot id (train.py:604-614).
+
+        Blobs are cached per id: each epoch M worker machines ask for the
+        same latest params, and serialization must not stall the dispatch
+        thread M times.
+        """
         latest_id = self.model_server.model_id
         if 0 < requested_id < latest_id:
+            cached = self._blob_cache.get(requested_id)
+            if cached is not None:
+                return requested_id, cached
             try:
                 params = load_params(
                     model_path(self.model_server.model_dir, requested_id),
                     self.model_server.latest_params(),
                 )
-                return requested_id, params_to_bytes(params)
+                blob = params_to_bytes(params)
+                self._trim_blob_cache()
+                self._blob_cache[requested_id] = blob
+                return requested_id, blob
             except Exception:
                 pass  # fall back to latest (reference train.py:608-613)
-        return latest_id, params_to_bytes(self.model_server.latest_params())
+        cached = self._blob_cache.get(latest_id)
+        if cached is None:
+            cached = params_to_bytes(self.model_server.latest_params())
+            self._trim_blob_cache()
+            self._blob_cache[latest_id] = cached
+        return latest_id, cached
+
+    def _trim_blob_cache(self, keep: int = 4) -> None:
+        while len(self._blob_cache) >= keep:
+            self._blob_cache.pop(next(iter(self._blob_cache)))
 
 
 # ---------------------------------------------------------------------------
@@ -278,15 +298,9 @@ class RemoteWorkerCluster:
         self.num_parallel = int(worker_args.get("num_parallel", 8))
 
     def _entry(self, retry_seconds: float = 60.0) -> Dict[str, Any]:
-        deadline = time.monotonic() + retry_seconds
-        while True:
-            try:
-                conn = connect_socket_connection(self.server_address, self.entry_port)
-                break
-            except OSError:
-                if time.monotonic() >= deadline:
-                    raise
-                time.sleep(0.5)  # server may still be booting; keep knocking
+        conn = connect_socket_connection(
+            self.server_address, self.entry_port, retry_seconds=retry_seconds
+        )
         try:
             return send_recv(conn, dict(self.worker_args, num_parallel=self.num_parallel))
         finally:

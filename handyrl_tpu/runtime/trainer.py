@@ -131,6 +131,7 @@ class Trainer:
         self.default_lr = 3e-8
         self.data_cnt_ema = args["batch_size"] * args["forward_steps"]
         self.steps = 0
+        self.last_loss: Dict[str, float] = {}
         self.update_flag = False
         self.update_queue: queue.Queue = queue.Queue(maxsize=1)
 
@@ -181,10 +182,8 @@ class Trainer:
             for k in fetched[0]
             if k != "dcnt"
         }
-        print(
-            "loss = %s"
-            % " ".join(f"{k}:{v / max(data_cnt, 1):.3f}" for k, v in loss_sum.items())
-        )
+        self.last_loss = {k: v / max(data_cnt, 1) for k, v in loss_sum.items()}
+        print("loss = %s" % " ".join(f"{k}:{v:.3f}" for k, v in self.last_loss.items()))
         self.data_cnt_ema = self.data_cnt_ema * 0.8 + data_cnt / (1e-2 + batch_cnt) * 0.2
         self.state_host = jax.device_get(self.state)
         return self.state_host["params"]

@@ -113,6 +113,17 @@ class Worker:
                     self.conn("result", result)
             except EngineStopped:
                 break  # learner shut the engine down mid-job; drain quietly
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                break  # transport gone; nothing left to report to
+            except Exception as exc:
+                # a transient job failure (e.g. one bad XLA batch fanned out
+                # to every engine waiter) must not kill the actor thread —
+                # a dead thread shrinks the pool and hangs learner shutdown
+                print(f"worker {self.wid} job failed: {type(exc).__name__}: {exc}")
+                if role == "g":
+                    self.conn("episode", None)  # keep the server's books consistent
+                elif role == "e":
+                    self.conn("result", None)
 
 
 class LocalWorkerPool:

@@ -1,0 +1,89 @@
+"""Shared training-log parsing for the plot scripts.
+
+The learner emits two streams (runtime/learner.py): a machine-readable
+``metrics.jsonl`` (one record per epoch) and human log lines whose format
+is parity with the reference's stdout convention — the reference's
+plotters regex-parse exactly those prefixes (win_rate_plot.py:34-45,
+loss_plot.py:33-42, stats_plot.py:36-42), so both inputs work here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List
+
+_WIN_RE = re.compile(r"win rate(?: \((?P<opp>[^)]*)\))? = (?P<wr>[\d.]+) \([\d.]+ / (?P<n>\d+)\)")
+_LOSS_RE = re.compile(r"loss = (?P<terms>(?:\w+:[-\d.]+ ?)+)")
+_GEN_RE = re.compile(r"generation stats = (?P<mean>[-\d.]+) \+- (?P<std>[-\d.]+)")
+_EPOCH_RE = re.compile(r"^epoch (?P<epoch>\d+)")
+_UPDATED_RE = re.compile(r"updated model\((?P<steps>\d+)\)")
+
+
+def parse_records(path: str) -> List[Dict[str, Any]]:
+    """Parse metrics.jsonl or a captured stdout log into epoch records."""
+    with open(path) as f:
+        first = f.read(1)
+    if first == "{":
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    return _parse_stdout(path)
+
+
+def _parse_stdout(path: str) -> List[Dict[str, Any]]:
+    records: List[Dict[str, Any]] = []
+    rec: Dict[str, Any] = {}
+    with open(path) as f:
+        for line in f:
+            m = _EPOCH_RE.match(line)
+            if m:
+                if rec:
+                    records.append(rec)
+                rec = {"epoch": int(m.group("epoch"))}
+                continue
+            m = _WIN_RE.search(line)
+            if m and rec:
+                rec.setdefault("win_rate", {})[m.group("opp") or "total"] = float(m.group("wr"))
+                rec.setdefault("eval_games", {})[m.group("opp") or "total"] = int(m.group("n"))
+                continue
+            m = _GEN_RE.search(line)
+            if m and rec:
+                rec["generation_mean"] = float(m.group("mean"))
+                rec["generation_std"] = float(m.group("std"))
+                continue
+            m = _LOSS_RE.search(line)
+            if m and rec:
+                terms = {}
+                for part in m.group("terms").split():
+                    k, v = part.split(":")
+                    terms[k] = float(v)
+                rec.setdefault("loss", terms)  # first loss line after the epoch header
+                continue
+            m = _UPDATED_RE.search(line)
+            if m and rec:
+                rec["steps"] = int(m.group("steps"))
+    if rec:
+        records.append(rec)
+    return records
+
+
+def smooth(values: List[float], k: int = 5) -> List[float]:
+    """Centered moving average, like the reference's smoothing windows."""
+    if k <= 1 or len(values) < 3:
+        return list(values)
+    out = []
+    for i in range(len(values)):
+        lo, hi = max(0, i - k // 2), min(len(values), i + k // 2 + 1)
+        out.append(sum(values[lo:hi]) / (hi - lo))
+    return out
+
+
+def save_or_show(fig, out_path: str | None) -> None:
+    if out_path:
+        fig.savefig(out_path, dpi=120, bbox_inches="tight")
+        print(f"wrote {out_path}")
+    else:
+        import matplotlib.pyplot as plt
+
+        plt.show()

@@ -1,0 +1,74 @@
+"""Stochastic Weight Averaging over epoch checkpoints.
+
+Parity with reference scripts/aux_swa.py:24-57: running equal-weight
+average of params from ``models/{ed-length+1}.ckpt`` .. ``models/{ed}.ckpt``
+written to ``models/swa.ckpt``, followed by a strict reload check.
+
+Usage:
+    python scripts/aux_swa.py [model_dir] [end_epoch] [length]
+
+Defaults: model_dir=models, end_epoch=newest on disk, length=all
+available.  The averaged file loads anywhere a normal checkpoint does
+(same flax-msgpack tree).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import numpy as np
+
+
+def epoch_checkpoints(model_dir: str):
+    eps = []
+    for fname in os.listdir(model_dir):
+        m = re.fullmatch(r"(\d+)\.ckpt", fname)
+        if m:
+            eps.append(int(m.group(1)))
+    return sorted(eps)
+
+
+def main() -> None:
+    from handyrl_tpu.runtime.checkpoint import load_params, model_path, save_params
+    from handyrl_tpu.utils import tree_map
+
+    model_dir = sys.argv[1] if len(sys.argv) >= 2 else "models"
+    epochs = epoch_checkpoints(model_dir)
+    if not epochs:
+        print(f"no epoch checkpoints in {model_dir}/")
+        sys.exit(1)
+    end = int(sys.argv[2]) if len(sys.argv) >= 3 else epochs[-1]
+    length = int(sys.argv[3]) if len(sys.argv) >= 4 else len(epochs)
+    window = [e for e in epochs if end - length + 1 <= e <= end]
+    if not window:
+        print(f"no checkpoints in window [{end - length + 1}, {end}]")
+        sys.exit(1)
+
+    # template tree from the first snapshot; running equal-weight average
+    template = load_params(model_path(model_dir, window[0]), None)
+    avg = tree_map(lambda x: np.asarray(x, np.float64), template)
+    for i, e in enumerate(window[1:], start=2):
+        params = load_params(model_path(model_dir, e), template)
+        avg = jax.tree_util.tree_map(lambda a, p: a + (np.asarray(p, np.float64) - a) / i, avg, params)
+    avg = jax.tree_util.tree_map(lambda a, t: np.asarray(a, np.asarray(t).dtype), avg, template)
+
+    out = os.path.join(model_dir, "swa.ckpt")
+    save_params(out, avg)
+
+    # strict reload check (reference aux_swa.py:50-57)
+    reloaded = load_params(out, template)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6),
+        avg,
+        reloaded,
+    )
+    print(f"averaged epochs {window} -> {out}")
+
+
+if __name__ == "__main__":
+    main()

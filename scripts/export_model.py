@@ -1,0 +1,49 @@
+"""Export a trained checkpoint to a deployable StableHLO artifact.
+
+Parity with reference scripts/make_onnx_model.py:28-58 (ONNX export with
+a dynamic batch axis), TPU-native: the artifact is serialized StableHLO
+with params baked in and a symbolic batch dimension, loadable by
+``handyrl_tpu.models.ExportedModel`` (and by ``--eval`` via a ``.hlo``
+path) without the model's python code.
+
+Usage:
+    python scripts/export_model.py <ckpt_path> [out_path]
+
+Reads env from ./config.yaml (like the reference reads config.yaml for
+the env to export).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    import yaml
+
+    from handyrl_tpu.config import normalize_args
+    from handyrl_tpu.envs import make_env, prepare_env
+    from handyrl_tpu.models import init_variables
+    from handyrl_tpu.models.export import export_model
+    from handyrl_tpu.runtime.checkpoint import load_params
+
+    ckpt = sys.argv[1] if len(sys.argv) >= 2 else "models/latest.ckpt"
+    out = sys.argv[2] if len(sys.argv) >= 3 else os.path.splitext(ckpt)[0] + ".hlo"
+
+    with open("config.yaml") as f:
+        args = normalize_args(yaml.safe_load(f) or {})
+    prepare_env(args["env_args"])
+    env = make_env(args["env_args"])
+    module = env.net()
+    variables = init_variables(module, env)
+    params = load_params(ckpt, variables["params"])
+    env.reset()
+    export_model(module, {"params": params}, env.observation(env.players()[0]), out)
+    print(f"exported {ckpt} -> {out}")
+
+
+if __name__ == "__main__":
+    main()

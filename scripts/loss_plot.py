@@ -1,0 +1,46 @@
+"""Plot training loss components over epochs.
+
+Parity with reference scripts/loss_plot.py:32-49; also reads
+metrics.jsonl directly.
+
+Usage: python scripts/loss_plot.py <log-or-metrics-path> [out.png]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+from _logparse import parse_records, save_or_show, smooth
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) >= 2 else "metrics.jsonl"
+    out = sys.argv[2] if len(sys.argv) >= 3 else "loss.png"
+    records = [r for r in parse_records(path) if r.get("loss")]
+    if not records:
+        print("no loss records found")
+        sys.exit(1)
+
+    terms = sorted({t for r in records for t in r["loss"]})
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for term in terms:
+        pts = [(r["epoch"], r["loss"][term]) for r in records if term in r["loss"]]
+        xs, ys = zip(*pts)
+        ax.plot(xs, smooth(list(ys)), label=term)
+    ax.set_xlabel("epoch")
+    ax.set_ylabel("loss")
+    ax.legend()
+    ax.set_title("loss components")
+    save_or_show(fig, out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Plot generation statistics (mean outcome ± std) over epochs.
+
+Parity with reference scripts/stats_plot.py:32-49; also reads
+metrics.jsonl directly.
+
+Usage: python scripts/stats_plot.py <log-or-metrics-path> [out.png]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+from _logparse import parse_records, save_or_show, smooth
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) >= 2 else "metrics.jsonl"
+    out = sys.argv[2] if len(sys.argv) >= 3 else "stats.png"
+    records = [r for r in parse_records(path) if "generation_mean" in r]
+    if not records:
+        print("no generation-stats records found")
+        sys.exit(1)
+
+    xs = [r["epoch"] for r in records]
+    means = smooth([r["generation_mean"] for r in records])
+    fig, ax = plt.subplots(figsize=(8, 5))
+    ax.plot(xs, means, label="generation mean")
+    stds = [r.get("generation_std") for r in records]
+    if all(s is not None for s in stds):
+        lo = [m - s for m, s in zip(means, stds)]
+        hi = [m + s for m, s in zip(means, stds)]
+        ax.fill_between(xs, lo, hi, alpha=0.2, label="±1 std")
+    ax.set_xlabel("epoch")
+    ax.set_ylabel("outcome")
+    ax.legend()
+    ax.set_title("generation stats")
+    save_or_show(fig, out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Plot win rate per evaluation opponent over epochs.
+
+Parity with reference scripts/win_rate_plot.py:33-51 (regex-parsed
+stdout -> smoothed curves); also reads metrics.jsonl directly.
+
+Usage: python scripts/win_rate_plot.py <log-or-metrics-path> [out.png]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+from _logparse import parse_records, save_or_show, smooth
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) >= 2 else "metrics.jsonl"
+    out = sys.argv[2] if len(sys.argv) >= 3 else "win_rate.png"
+    records = [r for r in parse_records(path) if r.get("win_rate")]
+    if not records:
+        print("no win-rate records found")
+        sys.exit(1)
+
+    opponents = sorted({opp for r in records for opp in r["win_rate"]})
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for opp in opponents:
+        pts = [(r["epoch"], r["win_rate"][opp]) for r in records if opp in r["win_rate"]]
+        xs, ys = zip(*pts)
+        ax.plot(xs, smooth(list(ys)), label=opp)
+    ax.axhline(0.5, color="gray", lw=0.5, ls="--")
+    ax.set_xlabel("epoch")
+    ax.set_ylabel("win rate")
+    ax.set_ylim(0, 1)
+    ax.legend()
+    ax.set_title("win rate vs opponents")
+    save_or_show(fig, out)
+
+
+if __name__ == "__main__":
+    main()

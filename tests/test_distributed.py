@@ -32,15 +32,8 @@ def free_port() -> int:
     return port
 
 
-def connect_retry(host: str, port: int, attempts: int = 50) -> FramedConnection:
-    import time
-
-    for i in range(attempts):
-        try:
-            return connect_socket_connection(host, port)
-        except OSError:
-            time.sleep(0.1)
-    raise ConnectionRefusedError(f"could not reach {host}:{port}")
+def connect_retry(host: str, port: int) -> FramedConnection:
+    return connect_socket_connection(host, port, retry_seconds=10.0)
 
 
 # -- codec ------------------------------------------------------------------

@@ -1,0 +1,152 @@
+"""Offline tooling tests: StableHLO export, SWA averaging, log plotters.
+
+Parity surface: reference scripts/ (aux_swa.py, make_onnx_model.py,
+win_rate/loss/stats plotters) per SURVEY.md §2.3.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model(env_name):
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.models import InferenceModel, init_variables
+
+    env = make_env({"env": env_name})
+    module = env.net()
+    variables = init_variables(module, env)
+    return env, module, variables, InferenceModel(module, variables)
+
+
+@pytest.mark.parametrize("env_name", ["TicTacToe", "Geister"])
+def test_export_roundtrip(env_name, tmp_path):
+    from handyrl_tpu.models import ExportedModel, export_model
+    from handyrl_tpu.utils import tree_stack
+
+    env, module, variables, model = _model(env_name)
+    env.reset()
+    obs = env.observation(env.players()[0])
+    path = str(tmp_path / f"{env_name}.hlo")
+    export_model(module, variables, obs, path)
+
+    ex = ExportedModel(path)
+    o1 = model.inference(obs, model.init_hidden())
+    o2 = ex.inference(obs, ex.init_hidden())
+    np.testing.assert_allclose(o1["policy"], o2["policy"], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(o1["value"], o2["value"], rtol=1e-4, atol=1e-5)
+
+    # dynamic batch dimension: batch-3 through the same artifact
+    obs_b = tree_stack([obs, obs, obs])
+    hidden = ex.init_hidden()
+    hidden_b = None if hidden is None else tree_stack([hidden] * 3)
+    out = ex.inference_batch(obs_b, hidden_b)
+    assert np.asarray(out["policy"]).shape[0] == 3
+
+
+def test_exported_model_plays_matches(tmp_path):
+    from handyrl_tpu.runtime.evaluation import exec_match, load_model_agent
+    from handyrl_tpu.agents import RandomAgent
+    from handyrl_tpu.models import export_model
+
+    env, module, variables, model = _model("TicTacToe")
+    env.reset()
+    path = str(tmp_path / "ttt.hlo")
+    export_model(module, variables, env.observation(0), path)
+
+    agents = {0: load_model_agent(path, env), 1: RandomAgent()}
+    outcome = exec_match(env, agents)
+    assert outcome is not None and set(outcome) == {0, 1}
+
+
+def test_swa_script(tmp_path):
+    from handyrl_tpu.runtime.checkpoint import load_params, model_path, save_params
+    from handyrl_tpu.utils import tree_map
+
+    env, module, variables, model = _model("TicTacToe")
+    model_dir = tmp_path / "models"
+    base = variables["params"]
+    for epoch, scale in ((1, 1.0), (2, 2.0), (3, 3.0)):
+        save_params(str(model_path(str(model_dir), epoch)), tree_map(lambda x: np.asarray(x) * scale, base))
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "aux_swa.py"), str(model_dir), "3", "3"],
+        capture_output=True,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO},
+    )
+    assert proc.returncode == 0, proc.stderr
+    swa = load_params(str(model_dir / "swa.ckpt"), base)
+    # average of 1x, 2x, 3x = 2x
+    np.testing.assert_allclose(
+        np.asarray(next(iter(jax_leaves(swa)))),
+        np.asarray(next(iter(jax_leaves(base)))) * 2.0,
+        rtol=1e-5,
+    )
+
+
+def jax_leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def test_logparse_both_formats(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from _logparse import parse_records
+
+    metrics = tmp_path / "metrics.jsonl"
+    with open(metrics, "w") as f:
+        for e in range(3):
+            f.write(json.dumps({"epoch": e, "win_rate": {"total": 0.5 + 0.1 * e},
+                                "loss": {"p": 0.4 - 0.1 * e, "v": 0.3},
+                                "generation_mean": 0.0, "generation_std": 0.9}) + "\n")
+    recs = parse_records(str(metrics))
+    assert len(recs) == 3 and recs[2]["win_rate"]["total"] == 0.7
+
+    log = tmp_path / "train.log"
+    log.write_text(
+        "started server\n"
+        "epoch 0\n"
+        "win rate = 0.520 (13.0 / 25)\n"
+        "generation stats = 0.100 +- 0.935\n"
+        "loss = ent:1.418 p:0.375 r:0.000 total:0.590 v:0.311\n"
+        "updated model(1)\n"
+        "epoch 1\n"
+        "win rate (random) = 0.769 (10.0 / 13)\n"
+        "generation stats = 0.200 +- 0.866\n"
+        "loss = ent:1.453 p:0.354 r:0.000 total:0.531 v:0.273\n"
+        "updated model(331)\n"
+    )
+    recs = parse_records(str(log))
+    assert len(recs) == 2
+    assert recs[0]["win_rate"]["total"] == 0.520
+    assert recs[1]["win_rate"]["random"] == 0.769
+    assert recs[1]["loss"]["p"] == 0.354
+    assert recs[1]["steps"] == 331
+    assert recs[0]["generation_mean"] == 0.1
+
+
+@pytest.mark.parametrize("script", ["win_rate_plot.py", "loss_plot.py", "stats_plot.py"])
+def test_plot_scripts(script, tmp_path):
+    metrics = tmp_path / "metrics.jsonl"
+    with open(metrics, "w") as f:
+        for e in range(5):
+            f.write(json.dumps({"epoch": e, "win_rate": {"total": 0.5, "random": 0.6},
+                                "loss": {"p": 0.4, "v": 0.3, "total": 0.7},
+                                "generation_mean": 0.1 * e, "generation_std": 0.5}) + "\n")
+    out = tmp_path / "plot.png"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", script), str(metrics), str(out)],
+        capture_output=True,
+        text=True,
+        env={**os.environ, "PYTHONPATH": REPO, "MPLBACKEND": "Agg"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert out.exists() and out.stat().st_size > 1000
