@@ -9,8 +9,8 @@ axis.  Hidden tensors ride along as an explicit pytree (the reference
 discovers them by the ``hidden*`` input-name prefix).
 
 Artifact format (our wire codec, runtime/codec.py):
-    {"mlir": <jax.export serialized bytes>, "hidden0": pytree|None,
-     "tree": <flattened output treedef repr>, "keys": [output names]}
+    {"mlir": <jax.export serialized bytes>, "hidden0": pytree|None}
+The output names/treedef ride inside the serialized jax.export blob.
 """
 
 from __future__ import annotations
@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils import tree_map
+from .inference import SingleInferenceMixin
 
 
 def _leaf_specs(pytree, scope, leading: str):
@@ -67,7 +68,7 @@ def export_model(module, variables, sample_obs, path: str) -> None:
         f.write(blob)
 
 
-class ExportedModel:
+class ExportedModel(SingleInferenceMixin):
     """Inference over a serialized artifact; same API as InferenceModel.
 
     Role of the reference's OnnxModel (evaluation.py:287-353): standalone
@@ -101,9 +102,3 @@ class ExportedModel:
                 hidden = self.init_hidden((n,))
             outputs = self._exported.call(obs, tree_map(jnp.asarray, hidden))
         return jax.device_get(outputs)
-
-    def inference(self, obs, hidden=None) -> Dict[str, Any]:
-        obs_b = tree_map(lambda x: np.asarray(x)[None], obs)
-        hidden_b = tree_map(lambda x: np.asarray(x)[None], hidden) if hidden is not None else None
-        outputs = self.inference_batch(obs_b, hidden_b)
-        return tree_map(lambda x: x[0], outputs)

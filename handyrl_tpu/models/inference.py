@@ -36,7 +36,18 @@ def init_variables(module, env, seed: int = 0):
     return module.init(jax.random.PRNGKey(seed), obs_b, hidden)
 
 
-class InferenceModel:
+class SingleInferenceMixin:
+    """Single-sample ``inference`` on top of a batched ``inference_batch``:
+    add the leading batch axis, run, strip it again (model.py:50-60)."""
+
+    def inference(self, obs, hidden=None) -> Dict[str, Any]:
+        obs_b = tree_map(lambda x: np.asarray(x)[None], obs)
+        hidden_b = tree_map(lambda x: np.asarray(x)[None], hidden) if hidden is not None else None
+        outputs = self.inference_batch(obs_b, hidden_b)
+        return tree_map(lambda x: x[0], outputs)
+
+
+class InferenceModel(SingleInferenceMixin):
     """A (module, variables) pair exposing batched and single inference.
 
     API kept compatible with the reference wrapper (model.py:50-60):
@@ -59,12 +70,6 @@ class InferenceModel:
     def inference_batch(self, obs, hidden=None) -> Dict[str, Any]:
         outputs = self._apply(self.variables, obs, hidden)
         return jax.device_get(outputs)
-
-    def inference(self, obs, hidden=None) -> Dict[str, Any]:
-        obs_b = tree_map(lambda x: np.asarray(x)[None], obs)
-        hidden_b = tree_map(lambda x: np.asarray(x)[None], hidden) if hidden is not None else None
-        outputs = self.inference_batch(obs_b, hidden_b)
-        return tree_map(lambda x: x[0], outputs)
 
 
 class RandomModel:

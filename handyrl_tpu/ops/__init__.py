@@ -1,4 +1,15 @@
 from .targets import compute_target
 from .losses import compute_loss_from_outputs
+from .ring_attention import (
+    full_attention_reference,
+    ring_attention_shard,
+    ring_self_attention,
+)
 
-__all__ = ["compute_target", "compute_loss_from_outputs"]
+__all__ = [
+    "compute_target",
+    "compute_loss_from_outputs",
+    "ring_attention_shard",
+    "ring_self_attention",
+    "full_attention_reference",
+]

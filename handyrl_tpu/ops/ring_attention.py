@@ -1,0 +1,141 @@
+"""Ring attention: sequence-parallel exact attention over an ``sp`` mesh axis.
+
+Long-context support beyond the reference (which scales sequence length
+*down* via windows + burn-in, SURVEY.md §5.7; train.py:93-107): here the
+time axis shards across devices and exact attention is computed blockwise
+— each device holds its Q shard, while K/V shards rotate around the ring
+via ``ppermute`` (one ICI hop per step), merged with a streaming
+(flash-style) softmax.  Memory per device is O(T/n) and the K/V transfer
+overlaps compute, so context length scales linearly with the mesh's
+``sp`` size.
+
+Layout: ``(B, T, H, D)`` — batch, time, heads, head dim.  Works standalone
+under ``shard_map`` (``ring_attention_shard``) or through the convenience
+wrapper ``ring_self_attention`` which builds the shard_map over a mesh
+with ``sp`` (and optionally ``dp``) axes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, q_off, k_off, scale, causal):
+    """One Q-shard x K/V-block attention with running-softmax stats.
+
+    q: (B, Tq, H, D); k, v: (B, Tk, H, D).
+    Returns (o, m, l): unnormalized output (B, Tq, H, D), row max (B, H, Tq),
+    row sum (B, H, Tq).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_off + jnp.arange(q.shape[1])
+        kpos = k_off + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = s.max(axis=-1)                                   # (B, H, Tq)
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(axis=-1)                                   # (B, H, Tq)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return o, m, l
+
+
+def ring_attention_shard(q, k, v, axis_name: str, causal: bool = True, vary_axes=()):
+    """Per-shard ring attention body; call inside shard_map.
+
+    Each participant holds contiguous time shards of equal length; shard i
+    owns positions [i*T_loc, (i+1)*T_loc).  K/V rotate to the next device
+    every step so after n steps every Q shard has seen every K/V shard.
+    ``vary_axes`` lists any additional manual mesh axes in scope (e.g. a
+    'dp' batch axis) so the accumulators carry the right varying type.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, T_loc, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    qf = q.astype(jnp.float32)
+
+    # accumulators start replicated but become device-varying inside the
+    # ring loop; pvary marks them so shard_map's VMA typing accepts the carry
+    vary = (axis_name,) + tuple(a for a in vary_axes if a)
+    o = jax.lax.pvary(jnp.zeros((B, T_loc, H, D), jnp.float32), vary)
+    m = jax.lax.pvary(jnp.full((B, H, T_loc), NEG_INF, jnp.float32), vary)
+    l = jax.lax.pvary(jnp.zeros((B, H, T_loc), jnp.float32), vary)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(i, carry):
+        o, m, l, k, v = carry
+        k_idx = (idx - i) % n  # owner of the K/V block currently held
+        o_blk, m_blk, l_blk = _block_attention(
+            qf, k.astype(jnp.float32), v, idx * T_loc, k_idx * T_loc, scale, causal
+        )
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)                       # rescale old accum
+        beta = jnp.exp(m_blk - m_new)                    # rescale new block
+        l = l * alpha + l_blk * beta
+        scale_old = jnp.moveaxis(alpha, 1, 2)[..., None]  # (B, Tq, H, 1)
+        scale_new = jnp.moveaxis(beta, 1, 2)[..., None]
+        o = o * scale_old + o_blk.astype(jnp.float32) * scale_new
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        return o, m_new, l, k, v
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
+    l = jnp.maximum(l, 1e-30)                            # fully-masked rows -> 0
+    out = o / jnp.moveaxis(l, 1, 2)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    causal: bool = True,
+    seq_axis: str = "sp",
+    batch_axis: Optional[str] = "dp",
+):
+    """Sequence-parallel attention over ``mesh``: shards T over ``seq_axis``
+    (and B over ``batch_axis`` when present in the mesh)."""
+    if seq_axis not in mesh.shape or mesh.shape[seq_axis] == 1:
+        # no sequence sharding: plain blockwise attention on each device
+        o, m, l = _block_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32), v, 0, 0, 1.0 / (q.shape[-1] ** 0.5), causal
+        )
+        return (o / jnp.moveaxis(jnp.maximum(l, 1e-30), 1, 2)[..., None]).astype(q.dtype)
+
+    b_axis = batch_axis if batch_axis in mesh.shape else None
+    spec = P(b_axis, seq_axis, None, None)
+    fn = shard_map(
+        functools.partial(
+            ring_attention_shard, axis_name=seq_axis, causal=causal, vary_axes=(b_axis,)
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def full_attention_reference(q, k, v, causal: bool = True):
+    """Naive O(T^2) attention for golden tests."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -1,4 +1,11 @@
-from .mesh import make_mesh, batch_sharding, replicated_sharding
+from .mesh import make_mesh, batch_sharding, param_shardings, replicated_sharding
 from .train_step import TrainContext, forward_prediction
 
-__all__ = ["make_mesh", "batch_sharding", "replicated_sharding", "TrainContext", "forward_prediction"]
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "param_shardings",
+    "TrainContext",
+    "forward_prediction",
+]

@@ -59,3 +59,23 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def param_shardings(mesh: Mesh, params):
+    """Tensor-parallel parameter layout over the 'mp' mesh axis.
+
+    Heuristic matching how dense/conv kernels want to split on TPU: a leaf
+    with >=2 dims whose output-channel (last) axis divides the 'mp' size is
+    sharded on that axis; everything else (biases, scales, small heads) is
+    replicated.  Without an 'mp' axis this degenerates to full replication
+    — the v1 data-parallel layout.  XLA/GSPMD inserts the collectives
+    implied by the layout (all-gather on column-parallel matmuls etc.).
+    """
+    mp = mesh.shape.get("mp", 1)
+
+    def shard(x):
+        if mp > 1 and getattr(x, "ndim", 0) >= 2 and x.shape[-1] % mp == 0:
+            return NamedSharding(mesh, PartitionSpec(*([None] * (x.ndim - 1)), "mp"))
+        return NamedSharding(mesh, PartitionSpec())
+
+    return jax.tree.map(shard, params)

@@ -36,7 +36,7 @@ import optax
 
 from ..ops import compute_loss_from_outputs
 from ..utils import tree_map
-from .mesh import batch_sharding, replicated_sharding
+from .mesh import batch_sharding, param_shardings, replicated_sharding
 
 
 def _flat_apply(module, params, obs, lead_shape):
@@ -178,20 +178,22 @@ class TrainContext:
             metrics["dcnt"] = dcnt
             return new_state, metrics
 
-        self._train_step = jax.jit(
-            _step,
-            in_shardings=(self._replicated, self._batch_shard, None),
-            out_shardings=(self._replicated, self._replicated),
-            donate_argnums=(0,),
-        )
+        # sharding follows the data: params/opt_state enter laid out by
+        # init_state (replicated, or 'mp'-sharded kernels when the mesh has
+        # a tensor-parallel axis), the batch enters 'dp'-sharded, and GSPMD
+        # propagates — the gradient all-reduce over ICI falls out of the
+        # layout rather than being spelled as explicit collectives.
+        self._train_step = jax.jit(_step, donate_argnums=(0,))
 
     def init_state(self, params) -> Dict[str, Any]:
-        state = {
+        params = jax.device_put(params, param_shardings(self.mesh, params))
+        # optimizer moments inherit the params' layout (zeros_like on device)
+        opt_state = jax.jit(self.tx.init)(params)
+        return {
             "params": params,
-            "opt_state": self.tx.init(params),
-            "steps": jnp.zeros((), jnp.int32),
+            "opt_state": opt_state,
+            "steps": jax.device_put(jnp.zeros((), jnp.int32), self._replicated),
         }
-        return jax.device_put(state, self._replicated)
 
     def put_batch(self, batch: Dict[str, Any]):
         B = batch["action"].shape[0]

@@ -128,15 +128,14 @@ class WorkerServer(QueueCommunicator):
         same latest params, and serialization must not stall the dispatch
         thread M times.
         """
-        latest_id = self.model_server.model_id
+        latest_id, latest_params = self.model_server.latest_snapshot()
         if 0 < requested_id < latest_id:
             cached = self._blob_cache.get(requested_id)
             if cached is not None:
                 return requested_id, cached
             try:
                 params = load_params(
-                    model_path(self.model_server.model_dir, requested_id),
-                    self.model_server.latest_params(),
+                    model_path(self.model_server.model_dir, requested_id), latest_params
                 )
                 blob = params_to_bytes(params)
                 self._trim_blob_cache()
@@ -146,7 +145,8 @@ class WorkerServer(QueueCommunicator):
                 pass  # fall back to latest (reference train.py:608-613)
         cached = self._blob_cache.get(latest_id)
         if cached is None:
-            cached = params_to_bytes(self.model_server.latest_params())
+            # id and params read atomically above, so the cache key is honest
+            cached = params_to_bytes(latest_params)
             self._trim_blob_cache()
             self._blob_cache[latest_id] = cached
         return latest_id, cached

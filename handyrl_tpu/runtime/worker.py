@@ -61,6 +61,12 @@ class LocalModelServer:
     def latest_params(self):
         return self._model.variables["params"]
 
+    def latest_snapshot(self):
+        """(model_id, params) read atomically — callers caching per id must
+        not pair a stale id with newer params published in between."""
+        with self._lock:
+            return self.model_id, self._model.variables["params"]
+
     def get(self, model_id: int):
         if model_id == 0:
             return self._random
