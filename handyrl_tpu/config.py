@@ -50,6 +50,7 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     "metrics_path": "metrics.jsonl",
     "model_dir": "models",
     "battle_port": 9876,
+    "profile_dir": None,
 }
 
 DEFAULT_WORKER_ARGS: Dict[str, Any] = {

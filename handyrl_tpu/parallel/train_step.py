@@ -195,6 +195,12 @@ class TrainContext:
             "steps": jax.device_put(jnp.zeros((), jnp.int32), self._replicated),
         }
 
+    def put_state(self, state_host: Dict[str, Any]) -> Dict[str, Any]:
+        """Lay a host-side (resumed) train state out on the mesh: every leaf
+        gets the same shape-based 'mp' rule as fresh params, so a checkpoint
+        written on any mesh restores onto this one."""
+        return jax.device_put(state_host, param_shardings(self.mesh, state_host))
+
     def put_batch(self, batch: Dict[str, Any]):
         B = batch["action"].shape[0]
         dp = self.mesh.shape.get("dp", 1)

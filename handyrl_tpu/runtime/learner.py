@@ -78,6 +78,12 @@ class Learner:
 
         mesh = make_mesh(self.args.get("mesh"))
         self.trainer = Trainer(self.args, self.module, params, mesh)
+        if self.model_epoch > 0:
+            state_path = os.path.join(self.model_dir, "state.ckpt")
+            if os.path.exists(state_path):
+                # adopts Adam moments + step count + lr EMA, but only when
+                # the file matches restart_epoch (an earlier epoch = branch)
+                self.trainer.load_state(state_path, self.model_epoch)
         self.model_server = LocalModelServer(self.module, make_env(args["env_args"]), self.args)
         self.model_server.publish(self.model_epoch, params)
 
@@ -93,7 +99,7 @@ class Learner:
         self._active_workers = 0
         self._shutdown_t0 = 0.0
         self._epoch_t0 = time.time()
-        self._epoch_steps0 = 0
+        self._epoch_steps0 = self.trainer.steps  # nonzero after a resume
         self._epoch_episodes0 = 0
         self._trainer_thread: Optional[threading.Thread] = None
 
@@ -180,6 +186,8 @@ class Learner:
 
         if self.trainer.last_loss:
             record["loss"] = dict(self.trainer.last_loss)
+        if self.trainer.stats:
+            record.update(self.trainer.stats)
         now = time.time()
         record.update(
             steps=steps,
@@ -197,7 +205,10 @@ class Learner:
         self.model_epoch += 1
         save_params(model_path(self.model_dir, self.model_epoch), params)
         save_params(latest_model_path(self.model_dir), params)
-        save_train_state(os.path.join(self.model_dir, "state.ckpt"), self.trainer.state_host)
+        save_train_state(
+            os.path.join(self.model_dir, "state.ckpt"),
+            self.trainer.save_payload(self.model_epoch),
+        )
         self.model_server.publish(self.model_epoch, params)
 
     def _write_metrics(self, record: Dict[str, Any]) -> None:

@@ -132,8 +132,44 @@ class Trainer:
         self.data_cnt_ema = args["batch_size"] * args["forward_steps"]
         self.steps = 0
         self.last_loss: Dict[str, float] = {}
+        self.stats: Dict[str, float] = {}  # step timing / input-starvation
         self.update_flag = False
         self.update_queue: queue.Queue = queue.Queue(maxsize=1)
+
+    def save_payload(self, epoch: int) -> Dict[str, Any]:
+        """Checkpoint payload: train state + epoch tag + lr-schedule EMA."""
+        return {
+            **self.state_host,
+            "epoch": np.int32(epoch),
+            "data_cnt_ema": np.float64(self.data_cnt_ema),
+        }
+
+    def load_state(self, path: str, expected_epoch: int) -> bool:
+        """Resume params + Adam moments + step count + lr EMA from state.ckpt.
+
+        The reference restarts Adam from scratch on resume (SURVEY.md §5.4);
+        here the full state round-trips, so the lr schedule and moments
+        continue where they left off.  Returns False (fresh optimizer) when
+        the file was written at a different epoch than ``expected_epoch`` —
+        restarting from an *earlier* snapshot is a branch, not a resume,
+        and must not adopt the later run's weights.
+        """
+        from .checkpoint import load_train_state
+
+        host = load_train_state(path, self.save_payload(0))
+        ckpt_epoch = int(host.pop("epoch"))
+        if ckpt_epoch != expected_epoch:
+            print(
+                f"state.ckpt is from epoch {ckpt_epoch}, not {expected_epoch}; "
+                "branching with a fresh optimizer"
+            )
+            return False
+        self.data_cnt_ema = float(host.pop("data_cnt_ema"))
+        self.state = self.ctx.put_state(host)
+        self.state_host = host
+        self.steps = int(host["steps"])
+        print(f"resumed train state at step {self.steps} from {path}")
+        return True
 
     @property
     def lr(self) -> float:
@@ -163,8 +199,12 @@ class Trainer:
         batch_cnt, data_cnt = 0, 0
         metric_accum = []
         lr = self.lr
+        wait_s = 0.0
+        t_epoch = time.perf_counter()
         while data_cnt == 0 or not self.update_flag:
+            t0 = time.perf_counter()
             batch = self.batcher.batch()
+            wait_s += time.perf_counter() - t0  # input starvation (north-star)
             if batch is None:  # shutting down
                 break
             self.state, metrics = self.ctx.train_step(self.state, batch, lr)
@@ -184,6 +224,11 @@ class Trainer:
         }
         self.last_loss = {k: v / max(data_cnt, 1) for k, v in loss_sum.items()}
         print("loss = %s" % " ".join(f"{k}:{v:.3f}" for k, v in self.last_loss.items()))
+        elapsed = max(time.perf_counter() - t_epoch, 1e-9)
+        self.stats = {
+            "train_steps_per_sec": batch_cnt / elapsed,
+            "input_wait_frac": wait_s / elapsed,
+        }
         self.data_cnt_ema = self.data_cnt_ema * 0.8 + data_cnt / (1e-2 + batch_cnt) * 0.2
         self.state_host = jax.device_get(self.state)
         return self.state_host["params"]
@@ -199,7 +244,23 @@ class Trainer:
             time.sleep(1)
         self.batcher.start()
         print("started training")
-        while not self.stop_event.is_set():
-            params = self.train_epoch()
-            self.update_flag = False
-            self.update_queue.put((params, self.steps))
+        profile_dir = self.args.get("profile_dir")
+        tracing = False
+        if profile_dir:
+            # capture the first trained epoch (SURVEY.md §5.1: the reference
+            # has no tracing at all; here it's one config key away)
+            jax.profiler.start_trace(profile_dir)
+            tracing = True
+        try:
+            while not self.stop_event.is_set():
+                params = self.train_epoch()
+                if tracing:
+                    jax.profiler.stop_trace()
+                    print(f"wrote profiler trace to {profile_dir}")
+                    tracing = False
+                self.update_flag = False
+                self.update_queue.put((params, self.steps))
+        finally:
+            if tracing:  # interrupted mid-first-epoch: still flush the trace
+                jax.profiler.stop_trace()
+                print(f"wrote profiler trace to {profile_dir}")
