@@ -30,7 +30,7 @@ class BaseEnvironment:
     """
 
     def __init__(self, args: Dict[str, Any] | None = None):
-        pass
+        self.args: Dict[str, Any] = dict(args or {})
 
     def __str__(self) -> str:
         return ""
@@ -109,5 +109,27 @@ class BaseEnvironment:
     # -- model factory ------------------------------------------------------
 
     def net(self):
-        """Return the Flax module for this game (policy/value net)."""
+        """Return the Flax module for this game (policy/value net).
+
+        Honors ``env_args['net'] == 'transformer'`` for every environment:
+        the generic KV-cache memory family (models/transformer.py) sized by
+        ``transformer_spec()``.  Environments implement ``default_net()``
+        for their bespoke architecture.
+        """
+        if self.args.get("net") == "transformer":
+            from ..models import TransformerNet
+
+            return TransformerNet(**self.transformer_spec())
+        return self.default_net()
+
+    def default_net(self):
+        """The environment's bespoke policy/value module."""
+        raise NotImplementedError()
+
+    def transformer_spec(self) -> Dict[str, Any]:
+        """Constructor kwargs for the generic TransformerNet family."""
+        return {"num_actions": self.action_size()}
+
+    def action_size(self) -> int:
+        """Total policy-head size (maximum action index + 1)."""
         raise NotImplementedError()

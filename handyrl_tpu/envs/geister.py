@@ -58,7 +58,6 @@ def _on_board(x, y):
 class Environment(BaseEnvironment):
     def __init__(self, args=None):
         super().__init__(args)
-        self.args = args or {}
         self.reset()
 
     def reset(self, args=None):
@@ -326,7 +325,13 @@ class Environment(BaseEnvironment):
 
         return {"scalar": scalar, "board": planes}
 
-    def net(self):
+    def action_size(self):
+        return 214  # 144 move + 70 layout logits
+
+    def transformer_spec(self):
+        return {"num_actions": self.action_size(), "with_return": True}
+
+    def default_net(self):
         from ..models import GeisterNet
 
         return GeisterNet()

@@ -255,7 +255,10 @@ class Environment(BaseEnvironment):
         planes[16, self.food] = 1
         return planes.reshape(-1, ROWS, COLS)
 
-    def net(self):
+    def action_size(self):
+        return 4
+
+    def default_net(self):
         from ..models import GeeseNet
 
         return GeeseNet()

@@ -117,7 +117,10 @@ class Environment(BaseEnvironment):
             ]
         ).astype(np.float32)
 
-    def net(self):
+    def action_size(self):
+        return 9
+
+    def default_net(self):
         from ..models import SimpleConvNet
 
         return SimpleConvNet()

@@ -1,4 +1,5 @@
 from .nets import SimpleConvNet, GeeseNet, GeisterNet
+from .transformer import TransformerNet
 from .inference import InferenceModel, RandomModel, init_variables
 from .export import ExportedModel, export_model
 
@@ -6,6 +7,7 @@ __all__ = [
     "SimpleConvNet",
     "GeeseNet",
     "GeisterNet",
+    "TransformerNet",
     "InferenceModel",
     "RandomModel",
     "init_variables",

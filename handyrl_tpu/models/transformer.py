@@ -1,0 +1,141 @@
+"""Transformer policy/value nets with a KV-cache ring buffer as hidden state.
+
+A model family beyond the reference's convnets/ConvLSTMs (SURVEY.md §2.2):
+episode memory is a fixed-size per-layer key/value cache instead of an
+RNN carry, so context is attention over the last ``memory_len`` steps.
+The cache IS the hidden-state pytree, which makes the family drop-in
+compatible with every existing path:
+
+* acting — ``initial_state``/``apply(obs, hidden)`` step semantics, so the
+  batched inference engine and agents work unchanged;
+* training — the lax.scan hidden-carry path (parallel/train_step.py)
+  trains it exactly like an RNN, burn-in included;
+* export — the cache rides as the ``hidden0`` pytree of StableHLO
+  artifacts (models/export.py).
+
+Positions use ALiBi-style additive age biases (slope per head), so ring
+wraparound needs no positional-embedding bookkeeping.  The cache write is
+a one-hot blend — O(memory_len) per step, branch-free, XLA-friendly.
+
+The sequence-parallel training path for very long windows is the ops
+layer's ring attention (ops/ring_attention.py); this module is the
+step-wise consumer of the same attention math.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+NEG_INF = -1e30
+
+
+def _alibi_slopes(n_heads: int) -> jnp.ndarray:
+    """Geometric head slopes as in ALiBi: 2^(-8i/n)."""
+    return jnp.asarray([2.0 ** (-8.0 * (i + 1) / n_heads) for i in range(n_heads)])
+
+
+def _flatten_obs(obs) -> jnp.ndarray:
+    """Env-agnostic encoder input: flatten and concat every obs leaf."""
+    leaves = jax.tree_util.tree_leaves(obs)
+    flat = [l.reshape(l.shape[0], -1).astype(jnp.float32) for l in leaves]
+    return jnp.concatenate(flat, axis=-1)
+
+
+class CachedSelfAttention(nn.Module):
+    """One decode-step of causal self-attention over a KV ring buffer."""
+
+    d_model: int
+    n_heads: int
+    memory_len: int
+
+    @nn.compact
+    def __call__(self, x, cache: Dict[str, jnp.ndarray], slot, count):
+        B = x.shape[0]
+        H, S = self.n_heads, self.memory_len
+        Dh = self.d_model // H
+
+        q = nn.Dense(H * Dh, name="q")(x).reshape(B, H, Dh)
+        k_new = nn.Dense(H * Dh, name="k")(x).reshape(B, H, Dh)
+        v_new = nn.Dense(H * Dh, name="v")(x).reshape(B, H, Dh)
+
+        oh = jax.nn.one_hot(slot, S, dtype=x.dtype)[..., None, None]     # (B,S,1,1)
+        k_cache = cache["k"] * (1 - oh) + oh * k_new[:, None]
+        v_cache = cache["v"] * (1 - oh) + oh * v_new[:, None]
+
+        scores = jnp.einsum("bhd,bshd->bhs", q, k_cache) / (Dh ** 0.5)
+        idx = jnp.arange(S)
+        age = (slot[:, None] - idx[None, :]) % S                          # 0 = newest
+        valid = age < count[:, None]
+        bias = -_alibi_slopes(H)[None, :, None] * age[:, None, :]
+        scores = jnp.where(valid[:, None, :], scores + bias, NEG_INF)
+        attn = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhs,bshd->bhd", attn, v_cache).reshape(B, H * Dh)
+        return nn.Dense(self.d_model, name="o")(out), {"k": k_cache, "v": v_cache}
+
+
+class TransformerNet(nn.Module):
+    """Generic memory-transformer policy/value net.
+
+    ``num_actions`` sets the policy head; ``with_return`` adds the reward-sum
+    head (Geister-style).  Observations of any pytree shape are flattened
+    into the token encoder, so one family serves every bundled env.
+    """
+
+    num_actions: int
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    memory_len: int = 32
+    mlp_ratio: int = 4
+    with_return: bool = False
+
+    @nn.compact
+    def __call__(self, obs, hidden=None, train: bool = False):
+        if hidden is None:
+            leaves = jax.tree_util.tree_leaves(obs)
+            hidden = self.initial_state((leaves[0].shape[0],))
+
+        x = nn.relu(nn.Dense(self.d_model, name="enc1")(_flatten_obs(obs)))
+        x = nn.Dense(self.d_model, name="enc2")(x)
+
+        pos = hidden["pos"]                     # float32 (B,): scan-carry safe
+        count = jnp.minimum(pos + 1, self.memory_len).astype(jnp.int32)
+        slot = jnp.mod(pos, float(self.memory_len)).astype(jnp.int32)
+
+        new_layers = []
+        for i, cache in enumerate(hidden["layers"]):
+            h = nn.LayerNorm(name=f"ln_a{i}")(x)
+            a, new_cache = CachedSelfAttention(
+                self.d_model, self.n_heads, self.memory_len, name=f"attn{i}"
+            )(h, cache, slot, count)
+            x = x + a
+            h = nn.LayerNorm(name=f"ln_m{i}")(x)
+            m = nn.Dense(self.mlp_ratio * self.d_model, name=f"mlp_up{i}")(h)
+            x = x + nn.Dense(self.d_model, name=f"mlp_dn{i}")(nn.relu(m))
+            new_layers.append(new_cache)
+
+        h = nn.LayerNorm(name="ln_f")(x)
+        out: Dict[str, Any] = {
+            "policy": nn.Dense(self.num_actions, name="policy")(h),
+            "value": jnp.tanh(nn.Dense(1, name="value")(h)),
+            "hidden": {"layers": tuple(new_layers), "pos": pos + 1.0},
+        }
+        if self.with_return:
+            out["return"] = nn.Dense(1, name="return_head")(h)
+        return out
+
+    @nn.nowrap
+    def initial_state(self, batch_dims: Sequence[int] = ()):
+        bd = tuple(batch_dims)
+        Dh = self.d_model // self.n_heads
+        cache = lambda: {  # noqa: E731
+            "k": jnp.zeros((*bd, self.memory_len, self.n_heads, Dh), jnp.float32),
+            "v": jnp.zeros((*bd, self.memory_len, self.n_heads, Dh), jnp.float32),
+        }
+        # pos is float32 so the train step's observation-mask arithmetic on
+        # the hidden carry (h * mask) never changes the carry dtype
+        return {"layers": tuple(cache() for _ in range(self.n_layers)), "pos": jnp.zeros(bd, jnp.float32)}

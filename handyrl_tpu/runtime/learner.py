@@ -84,6 +84,8 @@ class Learner:
                 # adopts Adam moments + step count + lr EMA, but only when
                 # the file matches restart_epoch (an earlier epoch = branch)
                 self.trainer.load_state(state_path, self.model_epoch)
+            else:
+                print(f"{state_path} not found; resuming with a fresh optimizer")
         self.model_server = LocalModelServer(self.module, make_env(args["env_args"]), self.args)
         self.model_server.publish(self.model_epoch, params)
 
