@@ -51,6 +51,9 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     "model_dir": "models",
     "battle_port": 9876,
     "profile_dir": None,
+    # whole-window attention training for transformer models (models that
+    # set supports_seq); turn off to force the step-scan path
+    "seq_forward": True,
 }
 
 DEFAULT_WORKER_ARGS: Dict[str, Any] = {

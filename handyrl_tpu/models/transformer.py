@@ -38,43 +38,89 @@ def _alibi_slopes(n_heads: int) -> jnp.ndarray:
     return jnp.asarray([2.0 ** (-8.0 * (i + 1) / n_heads) for i in range(n_heads)])
 
 
-def _flatten_obs(obs) -> jnp.ndarray:
-    """Env-agnostic encoder input: flatten and concat every obs leaf."""
+def _flatten_obs(obs, lead_dims: int = 1) -> jnp.ndarray:
+    """Env-agnostic encoder input: flatten and concat every obs leaf,
+    keeping the first ``lead_dims`` axes (batch, or batch+time)."""
     leaves = jax.tree_util.tree_leaves(obs)
-    flat = [l.reshape(l.shape[0], -1).astype(jnp.float32) for l in leaves]
+    flat = [
+        l.reshape(l.shape[:lead_dims] + (-1,)).astype(jnp.float32) for l in leaves
+    ]
     return jnp.concatenate(flat, axis=-1)
 
 
 class CachedSelfAttention(nn.Module):
-    """One decode-step of causal self-attention over a KV ring buffer."""
+    """Causal self-attention with two modes sharing one parameter set:
+
+    * step mode — one decode-step over a KV ring buffer (acting path);
+    * seq mode — a whole (B, T) window at once (training path): the
+      ring-buffer semantics are reproduced exactly with masks, so both
+      modes compute identical values: keys must be observed steps, ages
+      count *observed* steps (matching the commit-masked cache writes),
+      and keys older than ``memory_len`` observed steps are invisible
+      (ring eviction).  Burn-in keys get stop_gradient, matching the
+      scan path's no-grad warmup.
+    """
 
     d_model: int
     n_heads: int
     memory_len: int
 
     @nn.compact
-    def __call__(self, x, cache: Dict[str, jnp.ndarray], slot, count):
-        B = x.shape[0]
+    def __call__(self, x, cache=None, slot=None, count=None, seq: bool = False,
+                 key_mask=None, burn_in: int = 0):
         H, S = self.n_heads, self.memory_len
         Dh = self.d_model // H
 
-        q = nn.Dense(H * Dh, name="q")(x).reshape(B, H, Dh)
-        k_new = nn.Dense(H * Dh, name="k")(x).reshape(B, H, Dh)
-        v_new = nn.Dense(H * Dh, name="v")(x).reshape(B, H, Dh)
+        if not seq:
+            B = x.shape[0]
+            q = nn.Dense(H * Dh, name="q")(x).reshape(B, H, Dh)
+            k_new = nn.Dense(H * Dh, name="k")(x).reshape(B, H, Dh)
+            v_new = nn.Dense(H * Dh, name="v")(x).reshape(B, H, Dh)
 
-        oh = jax.nn.one_hot(slot, S, dtype=x.dtype)[..., None, None]     # (B,S,1,1)
-        k_cache = cache["k"] * (1 - oh) + oh * k_new[:, None]
-        v_cache = cache["v"] * (1 - oh) + oh * v_new[:, None]
+            oh = jax.nn.one_hot(slot, S, dtype=x.dtype)[..., None, None]  # (B,S,1,1)
+            k_cache = cache["k"] * (1 - oh) + oh * k_new[:, None]
+            v_cache = cache["v"] * (1 - oh) + oh * v_new[:, None]
 
-        scores = jnp.einsum("bhd,bshd->bhs", q, k_cache) / (Dh ** 0.5)
-        idx = jnp.arange(S)
-        age = (slot[:, None] - idx[None, :]) % S                          # 0 = newest
-        valid = age < count[:, None]
-        bias = -_alibi_slopes(H)[None, :, None] * age[:, None, :]
-        scores = jnp.where(valid[:, None, :], scores + bias, NEG_INF)
+            scores = jnp.einsum("bhd,bshd->bhs", q, k_cache) / (Dh ** 0.5)
+            idx = jnp.arange(S)
+            age = (slot[:, None] - idx[None, :]) % S                      # 0 = newest
+            valid = age < count[:, None]
+            bias = -_alibi_slopes(H)[None, :, None] * age[:, None, :]
+            scores = jnp.where(valid[:, None, :], scores + bias, NEG_INF)
+            attn = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhs,bshd->bhd", attn, v_cache).reshape(B, H * Dh)
+            return nn.Dense(self.d_model, name="o")(out), {"k": k_cache, "v": v_cache}
+
+        # -- seq mode: (B, T, d_model) ------------------------------------
+        B, T, _ = x.shape
+        q = nn.Dense(H * Dh, name="q")(x).reshape(B, T, H, Dh)
+        k = nn.Dense(H * Dh, name="k")(x).reshape(B, T, H, Dh)
+        v = nn.Dense(H * Dh, name="v")(x).reshape(B, T, H, Dh)
+
+        if burn_in > 0:  # scan parity: no gradients through warmup keys
+            bmask = (jnp.arange(T) < burn_in).astype(x.dtype)[None, :, None, None]
+            k = jax.lax.stop_gradient(k) * bmask + k * (1 - bmask)
+            v = jax.lax.stop_gradient(v) * bmask + v * (1 - bmask)
+
+        if key_mask is None:
+            key_mask = jnp.ones((B, T), x.dtype)
+        c = jnp.cumsum(key_mask, axis=1)                                  # observed count
+        age = c[:, :, None] - c[:, None, :]                               # (B, Tq, Tk)
+        t_idx = jnp.arange(T)
+        causal = t_idx[:, None] >= t_idx[None, :]
+        valid = (
+            (key_mask[:, None, :] > 0)
+            & causal[None]
+            & (age < S)
+            & (age >= 0)
+        )
+        valid = valid | jnp.eye(T, dtype=bool)[None]                      # self always visible
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (Dh ** 0.5)
+        scores = scores - _alibi_slopes(H)[None, :, None, None] * age[:, None]
+        scores = jnp.where(valid[:, None], scores, NEG_INF)
         attn = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhs,bshd->bhd", attn, v_cache).reshape(B, H * Dh)
-        return nn.Dense(self.d_model, name="o")(out), {"k": k_cache, "v": v_cache}
+        out = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(B, T, H * Dh)
+        return nn.Dense(self.d_model, name="o")(out), None
 
 
 class TransformerNet(nn.Module):
@@ -92,26 +138,38 @@ class TransformerNet(nn.Module):
     memory_len: int = 32
     mlp_ratio: int = 4
     with_return: bool = False
+    supports_seq: bool = True  # train path may call with seq=True
 
     @nn.compact
-    def __call__(self, obs, hidden=None, train: bool = False):
-        if hidden is None:
-            leaves = jax.tree_util.tree_leaves(obs)
-            hidden = self.initial_state((leaves[0].shape[0],))
-
-        x = nn.relu(nn.Dense(self.d_model, name="enc1")(_flatten_obs(obs)))
+    def __call__(self, obs, hidden=None, train: bool = False, *,
+                 seq: bool = False, key_mask=None, burn_in: int = 0):
+        if seq:
+            x = nn.relu(nn.Dense(self.d_model, name="enc1")(_flatten_obs(obs, 2)))
+            slot = count = None
+        else:
+            if hidden is None:
+                leaves = jax.tree_util.tree_leaves(obs)
+                hidden = self.initial_state((leaves[0].shape[0],))
+            x = nn.relu(nn.Dense(self.d_model, name="enc1")(_flatten_obs(obs)))
+            pos = hidden["pos"]                 # float32 (B,): scan-carry safe
+            count = jnp.minimum(pos + 1, self.memory_len).astype(jnp.int32)
+            slot = jnp.mod(pos, float(self.memory_len)).astype(jnp.int32)
         x = nn.Dense(self.d_model, name="enc2")(x)
 
-        pos = hidden["pos"]                     # float32 (B,): scan-carry safe
-        count = jnp.minimum(pos + 1, self.memory_len).astype(jnp.int32)
-        slot = jnp.mod(pos, float(self.memory_len)).astype(jnp.int32)
-
         new_layers = []
-        for i, cache in enumerate(hidden["layers"]):
+        for i in range(self.n_layers):
             h = nn.LayerNorm(name=f"ln_a{i}")(x)
             a, new_cache = CachedSelfAttention(
                 self.d_model, self.n_heads, self.memory_len, name=f"attn{i}"
-            )(h, cache, slot, count)
+            )(
+                h,
+                cache=None if seq else hidden["layers"][i],
+                slot=slot,
+                count=count,
+                seq=seq,
+                key_mask=key_mask,
+                burn_in=burn_in,
+            )
             x = x + a
             h = nn.LayerNorm(name=f"ln_m{i}")(x)
             m = nn.Dense(self.mlp_ratio * self.d_model, name=f"mlp_up{i}")(h)
@@ -122,8 +180,9 @@ class TransformerNet(nn.Module):
         out: Dict[str, Any] = {
             "policy": nn.Dense(self.num_actions, name="policy")(h),
             "value": jnp.tanh(nn.Dense(1, name="value")(h)),
-            "hidden": {"layers": tuple(new_layers), "pos": pos + 1.0},
         }
+        if not seq:
+            out["hidden"] = {"layers": tuple(new_layers), "pos": hidden["pos"] + 1.0}
         if self.with_return:
             out["return"] = nn.Dense(1, name="return_head")(h)
         return out

@@ -1,5 +1,6 @@
 from .targets import compute_target
 from .losses import compute_loss_from_outputs
+from .flash_attention import flash_attention
 from .ring_attention import (
     full_attention_reference,
     ring_attention_shard,
@@ -9,6 +10,7 @@ from .ring_attention import (
 __all__ = [
     "compute_target",
     "compute_loss_from_outputs",
+    "flash_attention",
     "ring_attention_shard",
     "ring_self_attention",
     "full_attention_reference",

@@ -1,0 +1,174 @@
+"""Pallas TPU flash-attention kernel (forward) with a recompute backward.
+
+A standalone long-context attention op: plain causal (or full) attention
+over contiguous fully-observed sequences — the regime where the O(T^2)
+score matrix stops fitting.  Note what it is NOT wired into: the
+transformer's seq training mode (models/transformer.py) needs per-key
+observation masks and observed-step age biases, which this kernel does
+not support, so that path uses an exact-mask einsum (fine at RL window
+lengths); ring attention (ops/ring_attention.py) needs externally-carried
+softmax accumulators across ring steps, which a complete-attention kernel
+cannot provide.  Callers with trivially-masked long sequences dispatch
+here directly.
+
+The forward is an online-softmax (flash) kernel:
+one grid program per (batch*head, query-tile) streams K/V tiles from VMEM,
+keeping running max / denominator so the T x T score matrix never
+materializes — O(T) memory instead of O(T^2), with the two matmuls on the
+MXU in fp32 accumulation.  Causal masking prunes the K-tile loop at the
+query tile's diagonal, halving work for causal training.
+
+The backward recomputes attention with standard XLA einsums (flash
+backward kernels trade FLOPs for memory the same way; XLA's fusion is
+already good at this shape, and recompute keeps the save-for-backward
+residuals at O(T)).
+
+Layout: (B, T, H, D) like the rest of the ops layer.  The head dim is
+zero-padded to the 128-lane tile internally; tiles are 128-aligned per
+the TPU tiling constraints (pallas_guide.md "Tiling Constraints").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+_LANE = 128
+
+
+def _reference(q, k, v, causal):
+    """XLA attention in fp32 — the math the kernel must match, also used to
+    derive the backward pass by recompute."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q, blk_k, n_k, causal, scale):
+    """One (batch-head, q-tile) program: stream K/V tiles with online softmax."""
+    qi = jax.lax.convert_element_type(_pl().program_id(1), jnp.int32)
+    q = q_ref[0].astype(jnp.float32)                       # (blk_q, D)
+
+    acc = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full((q.shape[0], 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((q.shape[0], 1), jnp.float32)
+
+    # causal: tiles strictly above the diagonal contribute nothing
+    upper = jnp.minimum((qi + 1) * blk_q, n_k * blk_k) if causal else n_k * blk_k
+    n_tiles = _pl().cdiv(upper, blk_k) if causal else n_k
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k = k_ref[0, _pl().ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        v = v_ref[0, _pl().ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                           # (blk_q, blk_k)
+        if causal:
+            qpos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            kpos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_blk = s.max(axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l
+
+    acc, m, l = jax.lax.fori_loop(0, n_tiles, body, (acc, m, l))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pl():
+    from jax.experimental import pallas as pl
+
+    return pl
+
+
+def _flash_forward(q, k, v, causal, blk_q, blk_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+
+    # (B*H, T, D_pad): fold heads into the grid, pad head dim to the lane tile
+    def fold(x):
+        x = jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)
+        if D % _LANE:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, _LANE - D % _LANE)))
+        return x
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    Dp = qf.shape[-1]
+    blk_q = min(blk_q, T)
+    blk_k = min(blk_k, T)
+    if T % blk_q or T % blk_k:
+        raise ValueError(f"sequence length {T} must divide into tiles {blk_q}/{blk_k}")
+    n_q, n_k = T // blk_q, T // blk_k
+
+    kernel = functools.partial(
+        _flash_kernel, blk_q=blk_q, blk_k=blk_k, n_k=n_k, causal=causal, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, n_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, Dp), lambda bh, qi: (bh, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, Dp), lambda bh, qi: (bh, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, Dp), lambda bh, qi: (bh, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, blk_q, Dp), lambda bh, qi: (bh, qi, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, Dp), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    out = out[..., :D].reshape(B, H, T, D)
+    return jnp.moveaxis(out, 1, 2)                          # (B, T, H, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    blk_q: int = 128,
+    blk_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Flash attention over (B, T, H, D); Pallas on TPU, interpreter elsewhere.
+
+    ``interpret=None`` auto-selects: compiled kernel on TPU backends, the
+    Pallas interpreter on CPU (slow but exact — for tests and dry runs).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, blk_q, blk_k, interpret)
+
+
+def _fwd(q, k, v, causal, blk_q, blk_k, interpret):
+    return flash_attention(q, k, v, causal, blk_q, blk_k, interpret), (q, k, v)
+
+
+def _bwd(causal, blk_q, blk_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

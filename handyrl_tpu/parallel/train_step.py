@@ -62,6 +62,26 @@ def forward_prediction(module, params, batch: Dict[str, Any], args: Dict[str, An
     if hidden0 is None:
         outputs = _flat_apply(module, params, obs, (B, T, P1))
         outputs = {k: v[:, burn_in:] for k, v in outputs.items()}
+    elif getattr(module, "supports_seq", False) and args.get("seq_forward", True):
+        # whole-window attention path: one batched call instead of a T-step
+        # scan — the masks reproduce the KV-cache semantics exactly (see
+        # CachedSelfAttention seq mode), so values match the scan path.
+        omask = batch["observation_mask"]
+        assert omask.shape[2] == P1, (
+            "recurrent training requires full-player batches "
+            "(set observation: true for RNN models)"
+        )
+        to_bp = lambda x: jnp.moveaxis(x, 2, 1).reshape((B * P1, T) + x.shape[3:])
+        obs_bp = tree_map(to_bp, obs)                       # (B*P, T, ...)
+        km = to_bp(omask)[..., 0]                           # (B*P, T)
+        outs = module.apply(
+            {"params": params}, obs_bp, None, seq=True, key_mask=km, burn_in=burn_in
+        )
+        outputs = {
+            k: jnp.moveaxis(v.reshape((B, P1, T) + v.shape[2:]), 1, 2)[:, burn_in:]
+            for k, v in outs.items()
+            if k != "hidden" and v is not None
+        }
     else:
         omask = batch["observation_mask"]
         assert omask.shape[2] == P1, (

@@ -84,6 +84,79 @@ def test_transformer_export_roundtrip(tmp_path):
     np.testing.assert_allclose(o1["policy"], o2["policy"], rtol=1e-4, atol=1e-5)
 
 
+def _transformer_batch(env_name, burn_in=2):
+    from handyrl_tpu.models import RandomModel
+    from handyrl_tpu.runtime import EpisodeStore, Generator, make_batch
+
+    cfg = normalize_args(
+        {
+            "env_args": {"env": env_name, "net": "transformer"},
+            "train_args": {
+                "batch_size": 8,
+                "forward_steps": 4,
+                "burn_in_steps": burn_in,
+                "compress_steps": 4,
+                "observation": True,
+            },
+        }
+    )
+    args = dict(cfg["train_args"])
+    args["env"] = cfg["env_args"]
+    env = make_env(args["env"])
+    module = env.net()
+    variables = init_variables(module, env)
+    model = InferenceModel(module, variables)
+    env.reset()
+    random_model = RandomModel.from_model(model, env.observation(env.players()[0]))
+    store = EpisodeStore(64)
+    gen = Generator(env, args)
+    gen_args = {"player": env.players(), "model_id": {p: 0 for p in env.players()}}
+    while len(store) < 6:
+        ep = gen.generate({p: random_model for p in env.players()}, gen_args)
+        if ep is not None:
+            store.extend([ep])
+    windows = []
+    while len(windows) < args["batch_size"]:
+        w = store.sample_window(args["forward_steps"], args["burn_in_steps"], args["compress_steps"])
+        if w is not None:
+            windows.append(w)
+    return env, module, variables, make_batch(windows, args), args
+
+
+def test_transformer_seq_path_matches_scan():
+    """The whole-window attention path must equal the KV-cache scan path —
+    in values AND in parameter gradients (burn-in stop_gradient included)."""
+    from handyrl_tpu.parallel import forward_prediction
+
+    env, module, variables, batch, args = _transformer_batch("TicTacToe")
+    batch = jax.tree.map(jax.numpy.asarray, batch)
+    out_seq = forward_prediction(module, variables["params"], batch, {**args, "seq_forward": True})
+    out_scan = forward_prediction(module, variables["params"], batch, {**args, "seq_forward": False})
+    assert set(out_seq) == set(out_scan)
+    for k in out_seq:
+        np.testing.assert_allclose(
+            np.asarray(out_seq[k]), np.asarray(out_scan[k]), rtol=2e-4, atol=2e-4
+        )
+
+    def loss(params, seq_forward):
+        # realistic downstream use: softmax over action-masked logits (the
+        # raw logits carry -1e32 mask values; squaring those is numeric noise)
+        outs = forward_prediction(module, params, batch, {**args, "seq_forward": seq_forward})
+        p = jax.nn.softmax(outs["policy"], axis=-1)
+        rest = sum((v ** 2).sum() for k, v in outs.items() if k != "policy")
+        return (p ** 2).sum() + rest
+
+    g_seq = jax.grad(lambda p: loss(p, True))(variables["params"])
+    g_scan = jax.grad(lambda p: loss(p, False))(variables["params"])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-4
+        ),
+        g_seq,
+        g_scan,
+    )
+
+
 @pytest.mark.parametrize("env_name", ["TicTacToe", "Geister"])
 def test_transformer_train_step(env_name):
     """Full sharded train step through the scan/burn-in recurrent path."""
