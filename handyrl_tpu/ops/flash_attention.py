@@ -1,4 +1,4 @@
-"""Pallas TPU flash-attention kernel (forward) with a recompute backward.
+"""Pallas TPU flash-attention kernel with an O(T·blk)-memory backward.
 
 A standalone long-context attention op: plain causal (or full) attention
 over contiguous fully-observed sequences — the regime where the O(T^2)
@@ -11,19 +11,18 @@ softmax accumulators across ring steps, which a complete-attention kernel
 cannot provide.  Callers with trivially-masked long sequences dispatch
 here directly.
 
-The forward is an online-softmax (flash) kernel:
-one grid program per (batch*head, query-tile) streams K/V tiles from VMEM,
-keeping running max / denominator so the T x T score matrix never
-materializes — O(T) memory instead of O(T^2), with the two matmuls on the
-MXU in fp32 accumulation.  Causal masking prunes the K-tile loop at the
-query tile's diagonal, halving work for causal training.
+Forward: one grid program per (batch*head, query-tile, key-tile) — K/V
+stream through VMEM one (blk_k, D) tile at a time while running
+max / denominator / output accumulators persist in VMEM scratch across
+the key-tile grid axis, so neither the score matrix nor the full K/V
+ever resides on-chip.  fp32 accumulation on the MXU; causal key tiles
+above the diagonal are predicated off.
 
-The backward recomputes attention with standard XLA einsums (flash
-backward kernels trade FLOPs for memory the same way; XLA's fusion is
-already good at this shape, and recompute keeps the save-for-backward
-residuals at O(T)).
+Backward: recompute per query-chunk under ``lax.scan`` — softmax vjp on
+a (blk, T) score slab per step, accumulating dK/dV — peak memory
+O(T·blk) instead of the O(T^2) a naive vjp residual would keep.
 
-Layout: (B, T, H, D) like the rest of the ops layer.  The head dim is
+Layout: (B, T, H, D) like the rest of the ops layer.  Head dims are
 zero-padded to the 128-lane tile internally; tiles are 128-aligned per
 the TPU tiling constraints (pallas_guide.md "Tiling Constraints").
 """
@@ -36,40 +35,33 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-NEG_INF = -1e30
+from .ring_attention import NEG_INF, full_attention_reference
+
 _LANE = 128
 
 
-def _reference(q, k, v, causal):
-    """XLA attention in fp32 — the math the kernel must match, also used to
-    derive the backward pass by recompute."""
-    scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
-    if causal:
-        T = q.shape[1]
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, blk_q, blk_k, n_k, causal, scale
+):
+    """One (batch-head, q-tile, k-tile) program; accumulators in scratch."""
+    pl = _pl()
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
 
+    @pl.when(kb == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q, blk_k, n_k, causal, scale):
-    """One (batch-head, q-tile) program: stream K/V tiles with online softmax."""
-    qi = jax.lax.convert_element_type(_pl().program_id(1), jnp.int32)
-    q = q_ref[0].astype(jnp.float32)                       # (blk_q, D)
+    # causal: key tiles strictly above the q tile's diagonal are no-ops
+    live = (kb * blk_k < (qi + 1) * blk_q) if causal else True
 
-    acc = jnp.zeros(q.shape, jnp.float32)
-    m = jnp.full((q.shape[0], 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((q.shape[0], 1), jnp.float32)
-
-    # causal: tiles strictly above the diagonal contribute nothing
-    upper = jnp.minimum((qi + 1) * blk_q, n_k * blk_k) if causal else n_k * blk_k
-    n_tiles = _pl().cdiv(upper, blk_k) if causal else n_k
-
-    def body(kb, carry):
-        acc, m, l = carry
-        k = k_ref[0, _pl().ds(kb * blk_k, blk_k), :].astype(jnp.float32)
-        v = v_ref[0, _pl().ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                           # (blk_q, blk_k)
@@ -77,18 +69,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q, blk_k, n_k, causal, scal
             qpos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
             kpos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_prev, l_prev, acc_prev = m_ref[:], l_ref[:], acc_ref[:]
         m_blk = s.max(axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, m_blk)
-        alpha = jnp.exp(m - m_new)
+        m_new = jnp.maximum(m_prev, m_blk)
+        alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        l = l * alpha + p.sum(axis=-1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
+        l_ref[:] = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_prev * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return acc, m_new, l
+        m_ref[:] = m_new
 
-    acc, m, l = jax.lax.fori_loop(0, n_tiles, body, (acc, m, l))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(kb == n_k - 1)
+    def _():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
 
 
 def _pl():
@@ -124,16 +118,21 @@ def _flash_forward(q, k, v, causal, blk_q, blk_k, interpret):
     )
     out = pl.pallas_call(
         kernel,
-        grid=(B * H, n_q),
+        grid=(B * H, n_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, blk_q, Dp), lambda bh, qi: (bh, qi, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, Dp), lambda bh, qi: (bh, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, Dp), lambda bh, qi: (bh, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_q, Dp), lambda bh, qi, kb: (bh, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, Dp), lambda bh, qi, kb: (bh, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, Dp), lambda bh, qi, kb: (bh, kb, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (1, blk_q, Dp), lambda bh, qi: (bh, qi, 0), memory_space=pltpu.VMEM
+            (1, blk_q, Dp), lambda bh, qi, kb: (bh, qi, 0), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((B * H, T, Dp), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, Dp), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
 
@@ -166,9 +165,44 @@ def _fwd(q, k, v, causal, blk_q, blk_k, interpret):
 
 
 def _bwd(causal, blk_q, blk_k, interpret, residuals, g):
+    """Chunked recompute backward: scan over query chunks, softmax-vjp each
+    (blk, T) score slab, accumulate dK/dV — peak memory O(T·blk)."""
     q, k, v = residuals
-    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal), q, k, v)
-    return vjp(g)
+    B, T, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    C = min(blk_q, T)
+    n_c = T // C
+
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+
+    q_chunks = jnp.moveaxis(qf.reshape(B, n_c, C, H, D), 1, 0)   # (n_c,B,C,H,D)
+    g_chunks = jnp.moveaxis(gf.reshape(B, n_c, C, H, D), 1, 0)
+    starts = jnp.arange(n_c) * C
+
+    def body(carry, inp):
+        dk, dv = carry
+        q_c, g_c, q0 = inp
+        s = jnp.einsum("bqhd,bkhd->bhqk", q_c, kf) * scale        # (B,H,C,T)
+        if causal:
+            qpos = q0 + jnp.arange(C)
+            kpos = jnp.arange(T)
+            s = jnp.where((qpos[:, None] >= kpos[None, :])[None, None], s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", g_c, vf)
+        ds = p * (dp - (dp * p).sum(axis=-1, keepdims=True))      # softmax vjp
+        dq_c = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
+        dk = dk + jnp.einsum("bhqk,bqhd->bkhd", ds, q_c) * scale
+        dv = dv + jnp.einsum("bhqk,bqhd->bkhd", p, g_c)
+        return (dk, dv), dq_c
+
+    (dk, dv), dq_chunks = jax.lax.scan(
+        body, (jnp.zeros_like(kf), jnp.zeros_like(vf)), (q_chunks, g_chunks, starts)
+    )
+    dq = jnp.moveaxis(dq_chunks, 0, 1).reshape(B, T, H, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -10,7 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from handyrl_tpu.ops.flash_attention import _reference, flash_attention
+from handyrl_tpu.ops.flash_attention import flash_attention
+from handyrl_tpu.ops.ring_attention import full_attention_reference as _reference
 
 
 def _qkv(seed, B, T, H, D, dtype=jnp.float32):
