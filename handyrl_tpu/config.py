@@ -54,6 +54,9 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     # whole-window attention training for transformer models (models that
     # set supports_seq); turn off to force the step-scan path
     "seq_forward": True,
+    # 'bfloat16' runs the forward/backward compute in bf16 (MXU rate)
+    # with fp32 master weights; 'float32' is exact
+    "compute_dtype": "float32",
 }
 
 DEFAULT_WORKER_ARGS: Dict[str, Any] = {

@@ -51,10 +51,32 @@ def _flat_apply(module, params, obs, lead_shape):
     }
 
 
+def _compute_dtype(args: Dict[str, Any]):
+    return jnp.bfloat16 if args.get("compute_dtype") == "bfloat16" else None
+
+
+def _cast_floats(tree, dtype):
+    return tree_map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, tree
+    )
+
+
 def forward_prediction(module, params, batch: Dict[str, Any], args: Dict[str, Any]) -> Dict[str, Any]:
     """Run the net over a (B, T, P, ...) batch; returns post-burn-in outputs
-    of length forward_steps, already turn/action/observation masked."""
+    of length forward_steps, already turn/action/observation masked.
+
+    With ``compute_dtype: bfloat16`` the forward runs in bf16 (params are
+    cast by the caller; observations/hidden here) — MXU-rate compute with
+    fp32 master weights.  Outputs are restored to fp32 before the masking
+    arithmetic (the 1e32 action mask is not bf16-representable)."""
+    cdt = _compute_dtype(args)
     obs = batch["observation"]
+    if cdt is not None:
+        # observations (and params, cast by the caller) carry bf16 through
+        # the net; recurrent hidden stays fp32 — the carry must keep one
+        # dtype across scan steps, and e.g. the transformer's step counter
+        # is not exactly representable in bf16 past 256
+        obs = _cast_floats(obs, cdt)
     B, T, P1 = batch["action"].shape[:3]
     burn_in = args["burn_in_steps"]
     hidden0 = module.initial_state((B, P1))
@@ -136,6 +158,7 @@ def forward_prediction(module, params, batch: Dict[str, Any], args: Dict[str, An
 
     masked = {}
     for k, v in outputs.items():
+        v = v.astype(jnp.float32)  # loss/target math stays fp32
         if k == "policy":
             v = v * tmask
             if v.shape[2] > 1 and P1 == 1:
@@ -179,8 +202,14 @@ class TrainContext:
 
         loss_keys = ("p", "v", "r", "ent", "total")
 
+        cdt = _compute_dtype(args)
+
         def _loss_fn(params, batch):
-            outputs = forward_prediction(self.module, params, batch, self.args)
+            # mixed precision: bf16 copies feed the forward, fp32 master
+            # params stay in the optimizer; grads come back fp32 through
+            # the cast's vjp
+            fwd_params = params if cdt is None else _cast_floats(params, cdt)
+            outputs = forward_prediction(self.module, fwd_params, batch, self.args)
             trimmed = trim_burn_in(batch, self.args["burn_in_steps"])
             losses, dcnt = compute_loss_from_outputs(outputs, trimmed, self.args)
             full = {k: losses.get(k, jnp.zeros(())) for k in loss_keys}

@@ -74,22 +74,20 @@ def test_param_shardings_mp_axis():
     assert all("mp" in (s.spec[-1] or ()) or s.spec[-1] == "mp" for s in sharded)
 
 
-def test_train_step_with_mp_mesh():
-    """Full sharded train step on a dp x mp mesh ends with finite loss."""
+def _env_batch(env_args, train_overrides):
     from handyrl_tpu.config import normalize_args
     from handyrl_tpu.envs import make_env
     from handyrl_tpu.models import InferenceModel, RandomModel, init_variables
-    from handyrl_tpu.parallel import TrainContext
     from handyrl_tpu.runtime import EpisodeStore, Generator, make_batch
 
     cfg = normalize_args(
         {
-            "env_args": {"env": "TicTacToe"},
+            "env_args": env_args,
             "train_args": {
                 "batch_size": 8,
                 "forward_steps": 4,
                 "compress_steps": 4,
-                "mesh": {"dp": 4, "mp": 2},
+                **train_overrides,
             },
         }
     )
@@ -115,8 +113,14 @@ def test_train_step_with_mp_mesh():
         w = store.sample_window(args["forward_steps"], args["burn_in_steps"], args["compress_steps"])
         if w is not None:
             windows.append(w)
-    batch = make_batch(windows, args)
+    return module, variables, make_batch(windows, args), args
 
+
+def test_train_step_with_mp_mesh():
+    """Full sharded train step on a dp x mp mesh ends with finite loss."""
+    from handyrl_tpu.parallel import TrainContext
+
+    module, variables, batch, args = _env_batch({"env": "TicTacToe"}, {"mesh": {"dp": 4, "mp": 2}})
     mesh = make_mesh(args["mesh"])
     ctx = TrainContext(module, args, mesh)
     state = ctx.init_state(variables["params"])
@@ -128,3 +132,35 @@ def test_train_step_with_mp_mesh():
         x.sharding.spec for x in jax.tree.leaves(state["params"]) if x.ndim >= 2
     ]
     assert any("mp" in [a for a in spec if a] for spec in kernel_shardings)
+
+
+@pytest.mark.parametrize(
+    "env_args,overrides",
+    [
+        ({"env": "TicTacToe"}, {}),                                    # feed-forward
+        ({"env": "Geister"}, {"observation": True}),                   # DRC scan
+        (
+            {"env": "TicTacToe", "net": "transformer"},
+            {"observation": True, "burn_in_steps": 2},                 # seq attention
+        ),
+    ],
+)
+def test_train_step_bfloat16(env_args, overrides):
+    """bf16 compute path: finite loss close to fp32, fp32 master weights."""
+    from handyrl_tpu.parallel import TrainContext
+
+    module, variables, batch, args = _env_batch(env_args, overrides)
+    mesh = make_mesh({"dp": -1})
+
+    losses = {}
+    for dtype in ("float32", "bfloat16"):
+        ctx = TrainContext(module, {**args, "compute_dtype": dtype}, mesh)
+        state = ctx.init_state(variables["params"])
+        state, metrics = ctx.train_step(state, ctx.put_batch(batch), 1e-4)
+        losses[dtype] = float(jax.device_get(metrics["total"]))
+        assert np.isfinite(losses[dtype])
+        assert all(
+            x.dtype == np.float32
+            for x in jax.tree.leaves(state["params"])
+        ), "master weights must stay fp32"
+    assert abs(losses["bfloat16"] - losses["float32"]) < 0.1 * (abs(losses["float32"]) + 1.0)
