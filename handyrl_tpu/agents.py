@@ -1,132 +1,184 @@
-"""Agent zoo for evaluation and match play.
+"""Model-driven and scripted agents for match play and evaluation.
 
-Capability parity with reference handyrl/agent.py:13-113: random,
-rule-based, greedy/temperature model agents, ensembles and the T=1.0 soft
-agent.  Models are anything with the ``inference``/``init_hidden`` API —
-an InferenceModel, a BatchedInferenceClient sharing the actor-side engine,
-a RandomModel, or an ensemble thereof.
+The acting API consumed by the match executors (runtime/evaluation.py) and
+the network battle client (runtime/battle.py) is three methods:
+
+    reset(env, show=False)
+    action(env, player, show=False) -> int
+    observe(env, player, show=False) -> value estimate (or None)
+
+Capability parity with the reference agent zoo (handyrl/agent.py:13-113)
+with a different construction: every model-backed agent is an ensemble —
+a single checkpoint is the one-member case — and action selection is
+vectorized numpy (masked logits + Gumbel-max sampling) rather than
+per-action python loops.  A "model" is anything exposing ``inference`` /
+``init_hidden``: a jitted InferenceModel, a BatchedInferenceClient sharing
+the actor-plane engine across threads, an ExportedModel, or the
+zero-output RandomModel.
 """
 
 from __future__ import annotations
 
-import random
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .utils import softmax
+_ILLEGAL = np.float32(-1e32)
+
+
+def masked_policy_logits(policy: np.ndarray, legal: Sequence[int]) -> np.ndarray:
+    """Return logits with every illegal entry pushed to -1e32."""
+    out = np.full(np.shape(policy), _ILLEGAL, np.float32)
+    idx = np.asarray(legal, np.int64)
+    out[idx] = np.asarray(policy, np.float32)[idx]
+    return out
+
+
+def sample_logits(
+    logits: np.ndarray, temperature: float, rng: np.random.Generator
+) -> int:
+    """Pick an action index from masked logits.
+
+    ``temperature == 0`` is argmax.  Otherwise Gumbel-max on
+    ``logits / temperature`` — distributionally identical to softmax
+    sampling, with no normalization pass and no underflow on the -1e32
+    illegal entries."""
+    if temperature <= 0.0:
+        return int(np.argmax(logits))
+    gumbel = rng.gumbel(size=np.shape(logits)).astype(np.float32)
+    return int(np.argmax(logits / np.float32(temperature) + gumbel))
+
+
+def _scalar(x) -> Optional[float]:
+    return None if x is None else float(np.asarray(x).reshape(-1)[0])
+
+
+def _display(env, prob: Optional[np.ndarray], value: Optional[float]) -> None:
+    """Human-readable decision dump; envs may provide their own renderer."""
+    if hasattr(env, "print_outputs"):
+        env.print_outputs(prob, value)
+        return
+    if value is not None:
+        print(f"v = {value:.4f}")
+    if prob is not None:
+        print("p =", np.round(prob * 1000).astype(np.int64))
 
 
 class RandomAgent:
-    """Uniform over legal actions (agent.py:13-22)."""
+    """Uniform over legal actions; the value estimate is a flat zero."""
 
-    def reset(self, env, show: bool = False):
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = np.random.default_rng(seed)
+
+    def reset(self, env, show: bool = False) -> None:
         pass
 
     def action(self, env, player: int, show: bool = False) -> int:
-        return random.choice(env.legal_actions(player))
+        return int(self._rng.choice(np.asarray(env.legal_actions(player))))
 
     def observe(self, env, player: int, show: bool = False):
         return [0.0]
 
 
 class RuleBasedAgent(RandomAgent):
-    """Delegates to the environment's scripted policy (agent.py:25-33)."""
+    """Environment-scripted policy where the env provides one, else random."""
 
-    def __init__(self, key: Optional[str] = None):
+    def __init__(self, key: Optional[str] = None, seed: Optional[int] = None):
+        super().__init__(seed)
         self.key = key
 
     def action(self, env, player: int, show: bool = False) -> int:
-        if hasattr(env, "rule_based_action"):
-            return env.rule_based_action(player, key=self.key)
-        return random.choice(env.legal_actions(player))
-
-
-def print_outputs(env, prob, v) -> None:
-    if hasattr(env, "print_outputs"):
-        env.print_outputs(prob, v)
-    else:
-        if v is not None:
-            print("v = %f" % v)
-        if prob is not None:
-            print("p = %s" % (prob * 1000).astype(int))
+        rule = getattr(env, "rule_based_action", None)
+        if rule is None:
+            return super().action(env, player, show)
+        return rule(player, key=self.key)
 
 
 class Agent:
-    """Greedy (or temperature-sampled) model agent with hidden-state carry.
+    """Model-backed agent: ensemble forward -> masked logits -> selection.
 
-    Parity with reference Agent (agent.py:36-89): ``reset`` re-seeds the
-    hidden state, ``action`` masks illegal actions and picks argmax (T=0)
-    or samples p^(1/T), ``observe`` returns the value estimate for
-    non-acting observation steps.
+    ``models`` may be a single model or a list; outputs are mean-pooled
+    across members (reference EnsembleAgent semantics, agent.py:92-107)
+    and each member carries its own recurrent state.
     """
 
-    def __init__(self, model, temperature: float = 0.0, observation: bool = True):
-        self.model = model
-        self.hidden = None
-        self.temperature = temperature
+    def __init__(
+        self,
+        models,
+        temperature: float = 0.0,
+        observation: bool = True,
+        seed: Optional[int] = None,
+    ):
+        self.models: List[Any] = (
+            list(models) if isinstance(models, (list, tuple)) else [models]
+        )
+        self.temperature = float(temperature)
         self.observation = observation
+        self._rng = np.random.default_rng(seed)
+        self._hidden: List[Any] = [None] * len(self.models)
 
-    def reset(self, env, show: bool = False):
-        self.hidden = self.model.init_hidden()
+    @property
+    def model(self):
+        """The first (or only) ensemble member."""
+        return self.models[0]
 
-    def plan(self, obs) -> Dict[str, Any]:
-        outputs = self.model.inference(obs, self.hidden)
-        self.hidden = outputs.get("hidden")
-        return outputs
+    def reset(self, env, show: bool = False) -> None:
+        self._hidden = [m.init_hidden() for m in self.models]
+
+    def _forward(self, obs) -> Dict[str, np.ndarray]:
+        """One inference per member; mean-pool everything but hidden state."""
+        member_outs = []
+        for i, m in enumerate(self.models):
+            out = m.inference(obs, self._hidden[i])
+            self._hidden[i] = out.get("hidden")
+            member_outs.append(out)
+        keys = {
+            k
+            for out in member_outs
+            for k, v in out.items()
+            if k != "hidden" and v is not None
+        }
+        return {
+            k: np.mean(
+                [
+                    np.asarray(out[k], np.float32)
+                    for out in member_outs
+                    if out.get(k) is not None
+                ],
+                axis=0,
+            )
+            for k in keys
+        }
 
     def action(self, env, player: int, show: bool = False) -> int:
-        outputs = self.plan(env.observation(player))
-        actions = env.legal_actions(player)
-        p = np.asarray(outputs["policy"], dtype=np.float32)
-        mask = np.ones_like(p) * 1e32
-        mask[actions] = 0.0
-        p = p - mask
-
+        outputs = self._forward(env.observation(player))
+        logits = masked_policy_logits(
+            np.reshape(outputs["policy"], -1), env.legal_actions(player)
+        )
         if show:
-            v = outputs.get("value")
-            print_outputs(env, softmax(p), None if v is None else float(np.reshape(v, -1)[0]))
-
-        if self.temperature == 0:
-            ap_list = sorted([(a, p[a]) for a in actions], key=lambda x: -x[1])
-            return ap_list[0][0]
-        prob = softmax(p / self.temperature)
-        return int(random.choices(np.arange(len(p)), weights=prob)[0])
+            exp = np.exp(logits - logits.max())
+            _display(env, exp / exp.sum(), _scalar(outputs.get("value")))
+        return sample_logits(logits, self.temperature, self._rng)
 
     def observe(self, env, player: int, show: bool = False):
-        v = None
-        if self.observation:
-            outputs = self.plan(env.observation(player))
-            v = outputs.get("value")
-            if show:
-                print_outputs(env, None, None if v is None else float(np.reshape(v, -1)[0]))
-        return v
+        if not self.observation:
+            return None
+        outputs = self._forward(env.observation(player))
+        value = outputs.get("value")
+        if show:
+            _display(env, None, _scalar(value))
+        return value
 
 
 class EnsembleAgent(Agent):
-    """Mean-pools outputs of several models (agent.py:92-107)."""
+    """Mean-pooled multi-checkpoint agent (Agent already pools lists)."""
 
     def __init__(self, models, temperature: float = 0.0, observation: bool = True):
-        super().__init__(models[0], temperature, observation)
-        self.models = models
-
-    def reset(self, env, show: bool = False):
-        self.hidden = [model.init_hidden() for model in self.models]
-
-    def plan(self, obs) -> Dict[str, Any]:
-        outputs = {}
-        for i, model in enumerate(self.models):
-            o = model.inference(obs, self.hidden[i])
-            self.hidden[i] = o.get("hidden")
-            for k, v in o.items():
-                if k == "hidden" or v is None:
-                    continue
-                outputs[k] = outputs.get(k, 0) + np.asarray(v) / len(self.models)
-        return outputs
+        super().__init__(list(models), temperature, observation)
 
 
 class SoftAgent(Agent):
-    """Temperature-1 sampling agent (agent.py:110-112)."""
+    """Softmax-sampling agent at temperature 1 (agent.py:110-112)."""
 
     def __init__(self, model):
         super().__init__(model, temperature=1.0)

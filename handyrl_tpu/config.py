@@ -45,6 +45,14 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     "restart_epoch": 0,
     # --- TPU-native additions -------------------------------------------
     "mesh": {"dp": -1},
+    # multi-host learner plane (parallel/distributed.py): set
+    # coordinator_address ("host:port" of process 0) + num_processes (+
+    # process_id or PROCESS_ID env) to span hosts with jax.distributed
+    "distributed": {
+        "coordinator_address": None,
+        "num_processes": 1,
+        "process_id": None,
+    },
     "inference_batch_size": 64,
     "prefetch_batches": 2,
     "metrics_path": "metrics.jsonl",

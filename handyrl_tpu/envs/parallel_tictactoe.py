@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import random
 
+import numpy as np
+
 from .tictactoe import Environment as TicTacToe, ROWS, COLS, WIN_LINES
 
 
@@ -49,10 +51,23 @@ class Environment(TicTacToe):
             self._apply(self.str2action(move), "OX".index(glyph))
 
     def turn(self):
-        return NotImplementedError()
+        raise NotImplementedError("simultaneous game: use turns()")
 
     def turns(self):
         return self.players()
+
+    def observation(self, player=None):
+        """Per-player view: [always-acting plane, my stones, theirs].
+
+        The reference inherits TicTacToe.observation, whose my-view check
+        compares the player against turn()'s sentinel return (reference
+        parallel_tictactoe.py:54) and silently picks the opponent view for
+        everyone; here the simultaneous-move perspective is explicit."""
+        color = self.BLACK if player in (None, 0) else self.WHITE
+        grid = self.cells.reshape(3, 3)
+        return np.stack(
+            [np.ones((3, 3)), grid == color, grid == -color]
+        ).astype(np.float32)
 
 
 if __name__ == "__main__":

@@ -68,11 +68,16 @@ def ring_attention_shard(q, k, v, axis_name: str, causal: bool = True, vary_axes
     qf = q.astype(jnp.float32)
 
     # accumulators start replicated but become device-varying inside the
-    # ring loop; pvary marks them so shard_map's VMA typing accepts the carry
+    # ring loop; marking them keeps shard_map's VMA typing happy with the
+    # carry.  jax.lax.pvary is deprecated in favor of pcast(..., to=varying).
     vary = (axis_name,) + tuple(a for a in vary_axes if a)
-    o = jax.lax.pvary(jnp.zeros((B, T_loc, H, D), jnp.float32), vary)
-    m = jax.lax.pvary(jnp.full((B, H, T_loc), NEG_INF, jnp.float32), vary)
-    l = jax.lax.pvary(jnp.zeros((B, H, T_loc), jnp.float32), vary)
+    if hasattr(jax.lax, "pcast"):
+        _mark = lambda x: jax.lax.pcast(x, vary, to="varying")
+    else:  # older jax
+        _mark = lambda x: jax.lax.pvary(x, vary)
+    o = _mark(jnp.zeros((B, T_loc, H, D), jnp.float32))
+    m = _mark(jnp.full((B, H, T_loc), NEG_INF, jnp.float32))
+    l = _mark(jnp.zeros((B, H, T_loc), jnp.float32))
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(i, carry):

@@ -1,5 +1,11 @@
 from .mesh import make_mesh, batch_sharding, param_shardings, replicated_sharding
 from .train_step import TrainContext, forward_prediction
+from .distributed import (
+    init_distributed,
+    is_coordinator,
+    local_batch_size,
+    process_count,
+)
 
 __all__ = [
     "make_mesh",
@@ -8,4 +14,8 @@ __all__ = [
     "param_shardings",
     "TrainContext",
     "forward_prediction",
+    "init_distributed",
+    "is_coordinator",
+    "local_batch_size",
+    "process_count",
 ]

@@ -1,0 +1,74 @@
+"""Multi-host (multi-process) initialization for the gradient plane.
+
+The reference scales out with its pickle/TCP worker tree only — its learner
+is single-host (``nn.DataParallel``, reference train.py:340-341).  Here the
+learner itself can span hosts: ``jax.distributed.initialize`` connects the
+processes, ``jax.devices()`` then returns the GLOBAL device list, and the
+same ``make_mesh``/``NamedSharding`` train step runs SPMD across hosts with
+XLA routing collectives over ICI within a slice and DCN across slices
+(SURVEY.md §2.5 gradient-plane prescription).
+
+Config (``train_args.distributed``)::
+
+    distributed:
+      coordinator_address: "10.0.0.1:1234"   # host:port of process 0
+      num_processes: 4
+      process_id: 0                          # or set via PROCESS_ID env
+
+Division of labor when initialized:
+
+* every process executes the jitted train step (SPMD requires all
+  processes to join every collective), feeding its local batch shard via
+  ``jax.make_array_from_process_local_data``;
+* only process 0 (``is_coordinator()``) writes checkpoints/metrics and
+  serves models to the actor plane — the guards live in
+  ``runtime/learner.py``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+
+
+def init_distributed(dist_args: Optional[Dict[str, Any]]) -> int:
+    """Initialize ``jax.distributed`` from config; returns the process index.
+
+    A missing/empty ``coordinator_address`` means single-process — no-op,
+    returns 0.  ``process_id`` may come from the config or the
+    ``PROCESS_ID`` environment variable (per-host launchers usually inject
+    the rank via env).
+    """
+    if not dist_args or not dist_args.get("coordinator_address"):
+        return 0
+    process_id = dist_args.get("process_id")
+    if process_id is None:
+        process_id = int(os.environ.get("PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=dist_args["coordinator_address"],
+        num_processes=int(dist_args["num_processes"]),
+        process_id=int(process_id),
+        local_device_ids=dist_args.get("local_device_ids"),
+    )
+    return jax.process_index()
+
+
+def is_coordinator() -> bool:
+    """True on the process that owns checkpoints, metrics, model serving."""
+    return jax.process_index() == 0
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def local_batch_size(global_batch_size: int) -> int:
+    """Per-process share of a global batch (SPMD data feeding)."""
+    n = jax.process_count()
+    if global_batch_size % n:
+        raise ValueError(
+            f"batch_size {global_batch_size} not divisible by {n} processes"
+        )
+    return global_batch_size // n

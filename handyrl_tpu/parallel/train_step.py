@@ -32,6 +32,7 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from ..ops import compute_loss_from_outputs
@@ -234,8 +235,19 @@ class TrainContext:
         # layout rather than being spelled as explicit collectives.
         self._train_step = jax.jit(_step, donate_argnums=(0,))
 
+    def _fresh_put(self, tree):
+        """Lay ``tree`` out on the mesh in NEW buffers.
+
+        ``jax.device_put`` may alias the source buffer as one shard of the
+        produced array; because the train step donates its state
+        (``donate_argnums=(0,)``), an aliased layout would delete the
+        caller's arrays on the first update.  A jitted identity always
+        materializes fresh outputs, so the caller keeps ownership."""
+        shardings = param_shardings(self.mesh, tree)
+        return jax.jit(lambda t: t, out_shardings=shardings)(tree)
+
     def init_state(self, params) -> Dict[str, Any]:
-        params = jax.device_put(params, param_shardings(self.mesh, params))
+        params = self._fresh_put(params)
         # optimizer moments inherit the params' layout (zeros_like on device)
         opt_state = jax.jit(self.tx.init)(params)
         return {
@@ -248,9 +260,23 @@ class TrainContext:
         """Lay a host-side (resumed) train state out on the mesh: every leaf
         gets the same shape-based 'mp' rule as fresh params, so a checkpoint
         written on any mesh restores onto this one."""
-        return jax.device_put(state_host, param_shardings(self.mesh, state_host))
+        return self._fresh_put(state_host)
 
     def put_batch(self, batch: Dict[str, Any]):
+        """Lay a host batch out dp-sharded.
+
+        Single-process: one device_put.  Multi-process (jax.distributed):
+        ``batch`` is this process's LOCAL shard (global_batch /
+        process_count rows); every process assembles its own shard and the
+        global array is built with make_array_from_process_local_data —
+        no cross-host batch traffic."""
+        if jax.process_count() > 1:
+            return jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(
+                    self._batch_shard, np.asarray(x)
+                ),
+                batch,
+            )
         B = batch["action"].shape[0]
         dp = self.mesh.shape.get("dp", 1)
         if B % dp != 0:

@@ -149,7 +149,17 @@ def _decode(r: _Reader) -> Any:
 
 def loads(buf: bytes) -> Any:
     r = _Reader(bytes(buf))
-    obj = _decode(r)
+    try:
+        obj = _decode(r)
+    except CodecError:
+        raise
+    except Exception as exc:
+        # a hostile/garbled frame must surface as CodecError so connection
+        # receive loops (which catch CodecError/OSError) drop the peer
+        # instead of dying: np.dtype(<junk>) raises TypeError, frombuffer /
+        # reshape size mismatches raise bare ValueError, unhashable decoded
+        # dict keys raise TypeError
+        raise CodecError(f"malformed frame: {type(exc).__name__}: {exc}") from exc
     if r.pos != len(r.buf):
         raise CodecError("trailing bytes after message")
     return obj

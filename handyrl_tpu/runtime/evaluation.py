@@ -223,11 +223,28 @@ def evaluate_mp(env_args: Dict[str, Any], agents: Dict[int, Any], num_games: int
     return results
 
 
-def eval_main(args: Dict[str, Any], argv: List[str]) -> None:
-    """`main.py --eval MODEL_PATH NUM_GAMES NUM_PROCESS` (evaluation.py:377-404).
+def parse_eval_spec(raw: str) -> Dict[str, Any]:
+    """`A[:B]` -> {"main": A, "opponent": B or 'random'}.
 
-    MODEL_PATH may be 'random', 'rulebase[-key]', a checkpoint path, or a
-    ':'-joined list of checkpoint paths (ensemble).
+    ':' separates the evaluated agent from the opponent (reference
+    evaluation.py:383-402: ``model_paths[1]`` becomes every other seat's
+    agent); '+' inside either side joins checkpoint paths into an ensemble.
+    """
+    parts = raw.split(":")
+    if len(parts) > 2:
+        raise ValueError(
+            f"eval spec {raw!r} has more than one ':'; use A:B (opponent) "
+            "and '+' to join ensemble members"
+        )
+    return {"main": parts[0], "opponent": parts[1] if len(parts) > 1 else "random"}
+
+
+def eval_main(args: Dict[str, Any], argv: List[str]) -> None:
+    """`main.py --eval MODELS NUM_GAMES NUM_WORKERS` (evaluation.py:377-404).
+
+    MODELS is `A[:B]`: A is evaluated, B (default 'random') fills every
+    other seat.  Each side may be 'random', 'rulebase[-key]', a checkpoint
+    or .hlo path, or a '+'-joined ensemble of checkpoint paths.
     """
     from ..agents import EnsembleAgent
     from ..envs import prepare_env
@@ -259,7 +276,7 @@ def eval_main(args: Dict[str, Any], argv: List[str]) -> None:
         agent = build_agent(spec, env)
         if agent is not None:
             return agent
-        paths = spec.split(":")
+        paths = spec.split("+")
         if len(paths) > 1:
             module = env.net()
             variables = init_variables(module, env)
@@ -269,10 +286,17 @@ def eval_main(args: Dict[str, Any], argv: List[str]) -> None:
             ]
             return EnsembleAgent(models)
         agent = load_model_agent(spec, env)
-        agent.model = share(agent.model)
+        agent.models[0] = share(agent.models[0])
         return agent
 
-    agents = {0: resolve(raw), 1: build_agent("random", env) or RandomAgent()}
+    spec = parse_eval_spec(raw)
+    agents = {0: resolve(spec["main"])}
+    if len(env.players()) > 1:
+        # resolve once: all opponent seats share one model/engine (per-game
+        # agent state is cloned per thread by evaluate_mp)
+        opponent = resolve(spec["opponent"])
+        for i in range(1, len(env.players())):
+            agents[i] = opponent
     try:
         evaluate_mp(env_args, agents, num_games, num_workers)
     finally:

@@ -32,7 +32,7 @@ from typing import Any, Dict, List, Optional
 
 from ..envs import make_env, prepare_env
 from ..models import init_variables
-from ..parallel import make_mesh
+from ..parallel import is_coordinator, make_mesh
 from .checkpoint import (
     latest_model_path,
     load_params,
@@ -205,17 +205,20 @@ class Learner:
     def update_model(self, params, steps: int) -> None:
         print("updated model(%d)" % steps)
         self.model_epoch += 1
-        save_params(model_path(self.model_dir, self.model_epoch), params)
-        save_params(latest_model_path(self.model_dir), params)
-        save_train_state(
-            os.path.join(self.model_dir, "state.ckpt"),
-            self.trainer.save_payload(self.model_epoch),
-        )
+        if is_coordinator():
+            # process-0 guard: under jax.distributed every process runs the
+            # SPMD train step, but exactly one owns the checkpoint files
+            save_params(model_path(self.model_dir, self.model_epoch), params)
+            save_params(latest_model_path(self.model_dir), params)
+            save_train_state(
+                os.path.join(self.model_dir, "state.ckpt"),
+                self.trainer.save_payload(self.model_epoch),
+            )
         self.model_server.publish(self.model_epoch, params)
 
     def _write_metrics(self, record: Dict[str, Any]) -> None:
         path = self.args.get("metrics_path")
-        if not path:
+        if not path or not is_coordinator():
             return
         with open(path, "a") as f:
             f.write(json.dumps(record, default=float) + "\n")

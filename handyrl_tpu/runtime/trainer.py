@@ -44,6 +44,11 @@ class BatchPipeline:
         self._host_queue: queue.Queue = queue.Queue(maxsize=max(2, args["num_batchers"]))
         self._device_queue: queue.Queue = queue.Queue(maxsize=args.get("prefetch_batches", 2))
         self._started = False
+        # under jax.distributed each process assembles its local shard of
+        # the global batch (TrainContext.put_batch builds the global array)
+        from ..parallel import local_batch_size
+
+        self._local_batch = local_batch_size(args["batch_size"])
 
     def start(self):
         if self._started:
@@ -55,7 +60,7 @@ class BatchPipeline:
 
     def _sample_windows(self):
         windows = []
-        while len(windows) < self.args["batch_size"]:
+        while len(windows) < self._local_batch:
             if self.stop_event.is_set():
                 return None
             w = self.store.sample_window(

@@ -35,12 +35,16 @@ if __name__ == "__main__":
     mode = sys.argv[1]
 
     if mode in ("--train", "-t"):
+        from handyrl_tpu.parallel import init_distributed
         from handyrl_tpu.runtime.learner import train_main
 
+        init_distributed(args["train_args"].get("distributed"))
         train_main(args)
     elif mode in ("--train-server", "-ts"):
+        from handyrl_tpu.parallel import init_distributed
         from handyrl_tpu.runtime.learner import train_server_main
 
+        init_distributed(args["train_args"].get("distributed"))
         train_server_main(args)
     elif mode in ("--worker", "-w"):
         from handyrl_tpu.runtime.server import worker_main

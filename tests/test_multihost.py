@@ -1,0 +1,103 @@
+"""Multi-host learner plane: jax.distributed over two localhost processes.
+
+The reference has no multi-host learner at all (nn.DataParallel is
+single-process, reference train.py:340-341); SURVEY.md §2.5 prescribes
+jax.distributed + XLA collectives for the gradient plane.  This test runs
+TWO real OS processes, each with 2 virtual CPU devices, connected through
+``init_distributed`` — the global mesh spans 4 devices — and checks:
+
+* a dp-sharded global array assembled from per-process local shards
+  (``TrainContext.put_batch``'s multi-process path) reduces correctly
+  through a jitted collective;
+* only the coordinator (process 0) passes the checkpoint/metrics guard.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_CHILD = r"""
+import json, os, sys
+
+port, pid, nproc, outdir = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from handyrl_tpu.parallel import (
+    init_distributed,
+    is_coordinator,
+    local_batch_size,
+    make_mesh,
+)
+
+rank = init_distributed(
+    {"coordinator_address": f"127.0.0.1:{port}", "num_processes": nproc, "process_id": pid}
+)
+assert rank == pid, (rank, pid)
+assert jax.process_count() == nproc
+assert len(jax.devices()) == 2 * nproc  # global device view
+
+mesh = make_mesh({"dp": -1})
+sharding = NamedSharding(mesh, PartitionSpec("dp"))
+
+# per-process local shard of a global batch: process p contributes rows p+1
+B_local = local_batch_size(4)
+local = np.full((B_local, 3), pid + 1.0, np.float32)
+arr = jax.make_array_from_process_local_data(sharding, local)
+total = jax.jit(lambda x: x.sum(), out_shardings=NamedSharding(mesh, PartitionSpec()))(arr)
+
+# the checkpoint/metrics guard: exactly one writer
+if is_coordinator():
+    with open(os.path.join(outdir, "result.json"), "w") as f:
+        json.dump({"total": float(total), "process_count": jax.process_count()}, f)
+else:
+    with open(os.path.join(outdir, f"noncoord_{pid}.txt"), "w") as f:
+        f.write("guarded")
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_cpu_distributed(tmp_path):
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CHILD, str(port), str(pid), "2", str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out.decode(errors="replace"))
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"child failed:\n{out}"
+
+    result = json.load(open(tmp_path / "result.json"))
+    assert result["process_count"] == 2
+    # global sum: 2 local rows x 3 cols of (pid+1) per process = 6*1 + 6*2
+    assert abs(result["total"] - 18.0) < 1e-6
+    assert (tmp_path / "noncoord_1.txt").exists()
+    assert not (tmp_path / "noncoord_0.txt").exists()

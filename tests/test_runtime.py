@@ -71,6 +71,52 @@ def test_soft_agent_samples_legal():
         assert a in env.legal_actions(env.turn())
 
 
+def test_parse_eval_spec():
+    """CLI parity: ':' separates evaluated model from opponent (reference
+    evaluation.py:383-402); '+' joins ensemble members."""
+    from handyrl_tpu.runtime.evaluation import parse_eval_spec
+
+    assert parse_eval_spec("models/1.ckpt") == {
+        "main": "models/1.ckpt",
+        "opponent": "random",
+    }
+    assert parse_eval_spec("models/1.ckpt:models/2.ckpt") == {
+        "main": "models/1.ckpt",
+        "opponent": "models/2.ckpt",
+    }
+    assert parse_eval_spec("a.ckpt+b.ckpt:rulebase") == {
+        "main": "a.ckpt+b.ckpt",
+        "opponent": "rulebase",
+    }
+    with pytest.raises(ValueError):
+        parse_eval_spec("a:b:c")
+
+
+def test_model_vs_model_eval():
+    """--eval A:B pits two checkpoints against each other offline."""
+    env, model = _tictactoe_model()
+    a = Agent(model)
+    b = Agent(InferenceModel(model.module, model.variables))
+    results = evaluate_mp({"env": "TicTacToe"}, {0: a, 1: b}, num_games=6, num_workers=2)
+    games = sum(sum(r.values()) for r in results.values())
+    assert games == 6
+
+
+def test_ensemble_agent_pools_members():
+    env, model = _tictactoe_model()
+    from handyrl_tpu.agents import EnsembleAgent
+
+    single = Agent(model)
+    double = EnsembleAgent([model, model])
+    env.reset()
+    single.reset(env)
+    double.reset(env)
+    obs = env.observation(env.turn())
+    np.testing.assert_allclose(
+        single._forward(obs)["policy"], double._forward(obs)["policy"], rtol=1e-5
+    )
+
+
 def test_evaluate_mp_random_vs_random(capsys):
     agents = {0: RandomAgent(), 1: RandomAgent()}
     results = evaluate_mp({"env": "TicTacToe"}, agents, num_games=20, num_workers=4)

@@ -6,14 +6,28 @@ tolerance for every algorithm / gamma / lambda / reward combination.
 Dev/judging aid only (needs torch + mounted reference).
 """
 
+import os
 import sys
+
+# this tool mixes torch and jax in one process: pin jax to CPU BEFORE any
+# backend init (otherwise a site-installed accelerator backend may be dialed
+# and hang) and keep both runtimes to one OpenMP thread each (oversubscribed
+# OpenMP pools from the two runtimes deadlock on this machine)
+os.environ.setdefault("OMP_NUM_THREADS", "1")
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import numpy as np
 
 sys.path.insert(0, "/root/repo")
 sys.path.insert(0, "/root/reference")
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import torch  # noqa: E402
+
+torch.set_num_threads(1)
 
 from handyrl.losses import compute_target as ref_compute_target  # noqa: E402
 from handyrl_tpu.ops.targets import compute_target as tpu_compute_target  # noqa: E402
