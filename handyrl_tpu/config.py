@@ -62,6 +62,9 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     # whole-window attention training for transformer models (models that
     # set supports_seq); turn off to force the step-scan path
     "seq_forward": True,
+    # seq-mode attention implementation: 'auto' (Pallas masked flash
+    # attention on TPU, einsum elsewhere), 'flash', or 'einsum'
+    "seq_attention": "auto",
     # 'bfloat16' runs the forward/backward compute in bf16 (MXU rate)
     # with fp32 master weights; 'float32' is exact
     "compute_dtype": "float32",

@@ -67,7 +67,7 @@ class CachedSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, cache=None, slot=None, count=None, seq: bool = False,
-                 key_mask=None, burn_in: int = 0):
+                 key_mask=None, burn_in: int = 0, use_flash: bool = False):
         H, S = self.n_heads, self.memory_len
         Dh = self.d_model // H
 
@@ -104,6 +104,18 @@ class CachedSelfAttention(nn.Module):
 
         if key_mask is None:
             key_mask = jnp.ones((B, T), x.dtype)
+
+        if use_flash:
+            # Pallas kernel with identical semantics (masks, observed-age
+            # ALiBi, ring eviction) — O(T·blk) memory instead of the O(T^2)
+            # score tensor; golden-tested against the einsum path below
+            from ..ops.flash_attention import masked_flash_attention
+
+            out = masked_flash_attention(
+                q, k, v, key_mask, _alibi_slopes(H), window=S
+            ).reshape(B, T, H * Dh)
+            return nn.Dense(self.d_model, name="o")(out), None
+
         c = jnp.cumsum(key_mask, axis=1)                                  # observed count
         age = c[:, :, None] - c[:, None, :]                               # (B, Tq, Tk)
         t_idx = jnp.arange(T)
@@ -142,7 +154,8 @@ class TransformerNet(nn.Module):
 
     @nn.compact
     def __call__(self, obs, hidden=None, train: bool = False, *,
-                 seq: bool = False, key_mask=None, burn_in: int = 0):
+                 seq: bool = False, key_mask=None, burn_in: int = 0,
+                 use_flash: bool = False):
         if seq:
             x = nn.relu(nn.Dense(self.d_model, name="enc1")(_flatten_obs(obs, 2)))
             slot = count = None
@@ -169,6 +182,7 @@ class TransformerNet(nn.Module):
                 seq=seq,
                 key_mask=key_mask,
                 burn_in=burn_in,
+                use_flash=use_flash,
             )
             x = x + a
             h = nn.LayerNorm(name=f"ln_m{i}")(x)

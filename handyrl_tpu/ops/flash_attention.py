@@ -1,15 +1,19 @@
-"""Pallas TPU flash-attention kernel with an O(T·blk)-memory backward.
+"""Pallas TPU flash-attention kernels with O(T·blk)-memory backwards.
 
-A standalone long-context attention op: plain causal (or full) attention
-over contiguous fully-observed sequences — the regime where the O(T^2)
-score matrix stops fitting.  Note what it is NOT wired into: the
-transformer's seq training mode (models/transformer.py) needs per-key
-observation masks and observed-step age biases, which this kernel does
-not support, so that path uses an exact-mask einsum (fine at RL window
-lengths); ring attention (ops/ring_attention.py) needs externally-carried
-softmax accumulators across ring steps, which a complete-attention kernel
-cannot provide.  Callers with trivially-masked long sequences dispatch
-here directly.
+Two entry points:
+
+* ``flash_attention`` — plain causal (or full) attention over contiguous
+  fully-observed sequences; the regime where the O(T^2) score matrix
+  stops fitting.
+* ``masked_flash_attention`` — the production transformer training path
+  (models/transformer.py seq mode): per-key observation masks, ALiBi-style
+  biases over *observed-step* ages, and ring-buffer eviction (keys older
+  than ``window`` observed steps invisible), all evaluated inside the
+  kernel from streamed (B, T) mask/count rows — bit-compatible with the
+  exact einsum reference in ``CachedSelfAttention``.
+
+Ring attention (ops/ring_attention.py) still carries its own softmax
+accumulators across ring steps and does not dispatch here.
 
 Forward: one grid program per (batch*head, query-tile, key-tile) — K/V
 stream through VMEM one (blk_k, D) tile at a time while running
@@ -23,8 +27,9 @@ a (blk, T) score slab per step, accumulating dK/dV — peak memory
 O(T·blk) instead of the O(T^2) a naive vjp residual would keep.
 
 Layout: (B, T, H, D) like the rest of the ops layer.  Head dims are
-zero-padded to the 128-lane tile internally; tiles are 128-aligned per
-the TPU tiling constraints (pallas_guide.md "Tiling Constraints").
+zero-padded to the 128-lane tile internally; sequence lengths are padded
+to the tile size with masked-off keys; tiles are 128-aligned per the TPU
+tiling constraints (pallas_guide.md "Tiling Constraints").
 """
 
 from __future__ import annotations
@@ -206,3 +211,243 @@ def _bwd(causal, blk_q, blk_k, interpret, residuals, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# masked flash attention: key masks + observed-age ALiBi + window eviction
+# ---------------------------------------------------------------------------
+
+
+def _masked_flash_kernel(
+    q_ref, k_ref, v_ref, cq_ref, ck_ref, mk_ref, slope_ref,
+    o_ref, acc_ref, m_ref, l_ref,
+    *, blk_q, blk_k, n_k, window, scale,
+):
+    """Like _flash_kernel, plus per-key validity streamed from (B, T) rows:
+
+    age[q, k]  = counts[q] - counts[k]       (observed-step age)
+    valid      = key_mask[k] & causal & 0 <= age < window,  OR  q == k
+    score      = q·k·scale − slope·age   (NEG_INF where invalid)
+
+    Invalid probabilities are zeroed explicitly so tiles whose every entry
+    is invalid cannot pollute the running denominator (exp(NEG_INF −
+    NEG_INF) = 1 would otherwise leak in before the first valid tile).
+    """
+    pl = _pl()
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    live = kb * blk_k < (qi + 1) * blk_q  # strictly-future key tiles: no-op
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        c_q = cq_ref[0].astype(jnp.float32)                  # (blk_q,)
+        c_k = ck_ref[0].astype(jnp.float32)                  # (blk_k,)
+        m_k = mk_ref[0].astype(jnp.float32)
+        slope = slope_ref[0, 0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                            # (blk_q, blk_k)
+        qpos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+        kpos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+        age = c_q[:, None] - c_k[None, :]
+        valid = (
+            (m_k[None, :] > 0)
+            & (qpos >= kpos)
+            & (age >= 0)
+            & (age < window)
+        )
+        valid = valid | (qpos == kpos)                        # self always visible
+        s = jnp.where(valid, s - slope * age, NEG_INF)
+
+        m_prev, l_prev, acc_prev = m_ref[:], l_ref[:], acc_ref[:]
+        m_blk = s.max(axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new) * valid.astype(jnp.float32)
+        l_ref[:] = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_prev * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_new
+
+    @pl.when(kb == n_k - 1)
+    def _():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _masked_scores(q_c, kf, c_q, counts, key_mask, slopes, window, q0, scale):
+    """Shared forward/backward score construction on an einsum slab:
+    (B, H, C, T) biased+masked scores for query chunk starting at q0."""
+    C = q_c.shape[1]
+    T = kf.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q_c, kf) * scale
+    age = c_q[:, :, None] - counts[:, None, :]                # (B, C, T)
+    qpos = q0 + jnp.arange(C)
+    kpos = jnp.arange(T)
+    valid = (
+        (key_mask[:, None, :] > 0)
+        & (qpos[:, None] >= kpos[None, :])[None]
+        & (age >= 0)
+        & (age < window)
+    )
+    valid = valid | (qpos[:, None] == kpos[None, :])[None]
+    s = s - slopes[None, :, None, None] * age[:, None]
+    s = jnp.where(valid[:, None], s, NEG_INF)
+    return s, valid
+
+
+def _masked_flash_forward(q, k, v, key_mask, slopes, window, blk_q, blk_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    counts = jnp.cumsum(key_mask.astype(jnp.float32), axis=1)  # observed count
+
+    blk_q = min(blk_q, _LANE)
+    blk_k = min(blk_k, _LANE)
+    Tp = -(-T // blk_q) * blk_q
+    Tp = -(-Tp // blk_k) * blk_k
+
+    def fold(x):
+        x = jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)
+        pads = ((0, 0), (0, Tp - T), (0, (-D) % _LANE))
+        return jnp.pad(x, pads)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    Dp = qf.shape[-1]
+    n_q, n_k = Tp // blk_q, Tp // blk_k
+
+    # padded key rows: mask 0 (invisible), counts edge-padded (finite ages)
+    mask_p = jnp.pad(key_mask.astype(jnp.float32), ((0, 0), (0, Tp - T)))
+    counts_p = jnp.pad(counts, ((0, 0), (0, Tp - T)), mode="edge")
+    slopes_col = jnp.tile(slopes.astype(jnp.float32)[None, :], (B, 1)).reshape(B * H, 1)
+
+    kernel = functools.partial(
+        _masked_flash_kernel,
+        blk_q=blk_q, blk_k=blk_k, n_k=n_k, window=float(window), scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, Dp), lambda bh, qi, kb: (bh, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, Dp), lambda bh, qi, kb: (bh, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, Dp), lambda bh, qi, kb: (bh, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_q), lambda bh, qi, kb: (bh // H, qi), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k), lambda bh, qi, kb: (bh // H, kb), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k), lambda bh, qi, kb: (bh // H, kb), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda bh, qi, kb: (bh, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, blk_q, Dp), lambda bh, qi, kb: (bh, qi, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tp, Dp), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, Dp), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, counts_p, counts_p, mask_p, slopes_col)
+
+    out = out[:, :T, :D].reshape(B, H, T, D)
+    return jnp.moveaxis(out, 1, 2)                            # (B, T, H, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def masked_flash_attention(
+    q, k, v, key_mask, slopes,
+    window: int = 1 << 30,
+    blk_q: int = 128,
+    blk_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Causal flash attention with per-key masks, observed-age ALiBi bias
+    and window eviction — the transformer seq-mode attention semantics
+    (models/transformer.py CachedSelfAttention) as one Pallas kernel.
+
+    q/k/v: (B, T, H, D); key_mask: (B, T) 1.0 = observed; slopes: (H,).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _masked_flash_forward(q, k, v, key_mask, slopes, window, blk_q, blk_k, interpret)
+
+
+def _masked_fwd(q, k, v, key_mask, slopes, window, blk_q, blk_k, interpret):
+    out = masked_flash_attention(q, k, v, key_mask, slopes, window, blk_q, blk_k, interpret)
+    return out, (q, k, v, key_mask, slopes)
+
+
+def _masked_bwd(window, blk_q, blk_k, interpret, residuals, g):
+    """Chunked recompute backward with the same masked/biased scores."""
+    q, k, v, key_mask, slopes = residuals
+    B, T, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    C = min(blk_q, T)
+    while T % C:
+        C -= 1
+    n_c = T // C
+
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    counts = jnp.cumsum(key_mask.astype(jnp.float32), axis=1)
+    slopes_f = slopes.astype(jnp.float32)
+
+    q_chunks = jnp.moveaxis(qf.reshape(B, n_c, C, H, D), 1, 0)
+    g_chunks = jnp.moveaxis(gf.reshape(B, n_c, C, H, D), 1, 0)
+    c_chunks = jnp.moveaxis(counts.reshape(B, n_c, C), 1, 0)
+    starts = jnp.arange(n_c) * C
+
+    def body(carry, inp):
+        dk, dv = carry
+        q_c, g_c, c_q, q0 = inp
+        s, valid = _masked_scores(q_c, kf, c_q, counts, key_mask, slopes_f, window, q0, scale)
+        p = jax.nn.softmax(s, axis=-1) * valid[:, None].astype(jnp.float32)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", g_c, vf)
+        ds = p * (dp - (dp * p).sum(axis=-1, keepdims=True))
+        dq_c = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
+        dk = dk + jnp.einsum("bhqk,bqhd->bkhd", ds, q_c) * scale
+        dv = dv + jnp.einsum("bhqk,bqhd->bkhd", p, g_c)
+        return (dk, dv), dq_c
+
+    (dk, dv), dq_chunks = jax.lax.scan(
+        body, (jnp.zeros_like(kf), jnp.zeros_like(vf)),
+        (q_chunks, g_chunks, c_chunks, starts),
+    )
+    dq = jnp.moveaxis(dq_chunks, 0, 1).reshape(B, T, H, D)
+    return (
+        dq.astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+        jnp.zeros_like(key_mask),
+        jnp.zeros_like(slopes),
+    )
+
+
+masked_flash_attention.defvjp(_masked_fwd, _masked_bwd)
+
+
+def masked_attention_reference(q, k, v, key_mask, slopes, window: int = 1 << 30):
+    """Exact einsum counterpart of masked_flash_attention (golden tests)."""
+    B, T, H, D = q.shape
+    counts = jnp.cumsum(key_mask.astype(jnp.float32), axis=1)
+    s, valid = _masked_scores(
+        q.astype(jnp.float32), k.astype(jnp.float32), counts, counts,
+        key_mask, slopes.astype(jnp.float32), window, 0, 1.0 / (D ** 0.5),
+    )
+    attn = jax.nn.softmax(s, axis=-1) * valid[:, None].astype(jnp.float32)
+    return jnp.einsum("bhqk,bkhd->bqhd", attn, v.astype(jnp.float32)).astype(q.dtype)

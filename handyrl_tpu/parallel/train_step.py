@@ -97,8 +97,13 @@ def forward_prediction(module, params, batch: Dict[str, Any], args: Dict[str, An
         to_bp = lambda x: jnp.moveaxis(x, 2, 1).reshape((B * P1, T) + x.shape[3:])
         obs_bp = tree_map(to_bp, obs)                       # (B*P, T, ...)
         km = to_bp(omask)[..., 0]                           # (B*P, T)
+        # seq_attention: 'einsum' (exact O(T^2) path), 'flash' (Pallas
+        # masked flash-attention kernel), or 'auto' (flash on TPU backends)
+        mode = args.get("seq_attention", "auto")
+        use_flash = mode == "flash" or (mode == "auto" and jax.default_backend() == "tpu")
         outs = module.apply(
-            {"params": params}, obs_bp, None, seq=True, key_mask=km, burn_in=burn_in
+            {"params": params}, obs_bp, None, seq=True, key_mask=km,
+            burn_in=burn_in, use_flash=use_flash,
         )
         outputs = {
             k: jnp.moveaxis(v.reshape((B, P1, T) + v.shape[2:]), 1, 2)[:, burn_in:]

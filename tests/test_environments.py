@@ -145,6 +145,143 @@ def test_hungry_geese_ranking():
     assert abs(sum(out.values())) < 1e-9
 
 
+class TestHungryGeeseRules:
+    """Pin every official-interpreter rule from docs/hungry_geese_parity.md
+    (kaggle_environments is not installable here, so each rule is pinned by
+    a constructed position instead of a lock-step trace)."""
+
+    def _env(self):
+        e = _make("HungryGeese")
+        e.reset()
+        return e
+
+    @staticmethod
+    def _cell(r, c):
+        return r * 11 + c
+
+    def _setup(self, e, geese, food):
+        e.geese = [list(g) for g in geese]
+        e.active = [bool(g) for g in geese]
+        e.food = list(food)
+        e.last_actions = {}
+        e.step_count = 0
+
+    def test_reverse_death(self):
+        e = self._env()
+        self._setup(e, [[self._cell(3, 3)], [self._cell(0, 0)], [], []], [self._cell(6, 10)])
+        e.last_actions = {0: 0}  # last moved NORTH
+        e.step({0: 1, 1: 0})  # 0 reverses SOUTH -> dies
+        assert not e.active[0] and e.geese[0] == []
+
+    def test_food_growth_keeps_tail(self):
+        e = self._env()
+        head, tail = self._cell(3, 3), self._cell(3, 2)
+        food = self._cell(2, 3)  # north of head
+        self._setup(e, [[head, tail], [self._cell(6, 0)], [], []], [food, self._cell(6, 10)])
+        e.step({0: 0, 1: 0})  # NORTH onto food
+        assert e.geese[0] == [food, head, tail]  # grew, tail kept
+        assert food not in e.food
+
+    def test_move_without_food_pops_tail(self):
+        e = self._env()
+        head, tail = self._cell(3, 3), self._cell(3, 2)
+        self._setup(e, [[head, tail], [self._cell(6, 0)], [], []], [self._cell(6, 10)])
+        e.step({0: 0, 1: 0})
+        assert e.geese[0] == [self._cell(2, 3), head]
+
+    def test_chasing_own_tail_is_legal(self):
+        """Rule 3: tail pops before the self-collision check, so moving into
+        the current tail cell (not eating) is legal."""
+        e = self._env()
+        # 2x2 loop: head at (3,3), body (3,4), (4,4), tail (4,3); EAST... use
+        # square ring and move head onto the vacating tail cell
+        ring = [self._cell(3, 3), self._cell(3, 4), self._cell(4, 4), self._cell(4, 3)]
+        self._setup(e, [ring, [self._cell(0, 0)], [], []], [self._cell(6, 10)])
+        e.step({0: 1, 1: 0})  # SOUTH onto (4,3) = current tail
+        assert e.active[0]
+        assert e.geese[0] == [self._cell(4, 3), self._cell(3, 3), self._cell(3, 4), self._cell(4, 4)]
+
+    def test_self_collision_death(self):
+        e = self._env()
+        # long body: moving EAST hits own body cell that does NOT vacate
+        g = [self._cell(3, 3), self._cell(2, 3), self._cell(2, 4), self._cell(3, 4), self._cell(4, 4), self._cell(4, 3)]
+        self._setup(e, [g, [self._cell(0, 0)], [], []], [self._cell(6, 10)])
+        e.step({0: 3, 1: 0})  # EAST into (3,4)
+        assert not e.active[0]
+
+    def test_hunger_pops_tail_on_step_40(self):
+        e = self._env()
+        head, tail = self._cell(3, 3), self._cell(3, 2)
+        self._setup(e, [[head, tail], [self._cell(6, 0)], [], []], [self._cell(6, 10)])
+        e.step_count = 39  # this step becomes 40
+        e.step({0: 0, 1: 0})
+        assert len(e.geese[0]) == 1  # moved (pop) + hunger (pop) from 2+head
+
+    def test_hunger_starves_length_one(self):
+        e = self._env()
+        self._setup(e, [[self._cell(3, 3)], [self._cell(6, 0), self._cell(6, 1)], [], []], [self._cell(0, 5)])
+        e.step_count = 39
+        e.step({0: 0, 1: 0})
+        assert e.geese[0] == []  # shrank to zero
+        assert e.geese[1]        # survived (game then ends: last goose standing)
+        assert e.terminal()
+
+    def test_head_to_head_collision_kills_both(self):
+        e = self._env()
+        a, b = self._cell(3, 3), self._cell(3, 5)
+        self._setup(e, [[a], [b], [self._cell(0, 0)], []], [self._cell(6, 10)])
+        e.step({0: 3, 1: 2, 2: 0})  # both into (3,4)
+        assert e.geese[0] == [] and e.geese[1] == []
+        assert e.geese[2]  # last goose standing; game ends
+        assert e.terminal()
+
+    def test_head_into_body_kills_mover_only(self):
+        e = self._env()
+        mover = [self._cell(3, 3)]
+        wall = [self._cell(2, 4), self._cell(2, 3), self._cell(2, 2)]
+        # wall moves SOUTH to (3,4); mover EAST to (3,4)? that's head-to-head.
+        # Instead: mover NORTH into wall's mid-body cell (2,3) which stays.
+        self._setup(e, [mover, wall, [self._cell(6, 0)], []], [self._cell(6, 10)])
+        e.step({0: 0, 1: 1, 2: 0})  # wall head (2,4) SOUTH to (3,4)
+        assert not e.active[0]
+        assert e.active[1]
+
+    def test_shared_food_lower_index_eats_both_die(self):
+        e = self._env()
+        food = self._cell(3, 4)
+        self._setup(e, [[self._cell(3, 3)], [self._cell(3, 5)], [self._cell(0, 0)], []], [food, self._cell(6, 10)])
+        e.step({0: 3, 1: 2, 2: 0})
+        assert food not in e.food  # removed exactly once
+        assert not e.active[0] and not e.active[1]
+
+    def test_dead_goose_keeps_previous_reward(self):
+        """Rule 9: rewards update only for survivors, after deaths."""
+        e = self._env()
+        self._setup(e, [[self._cell(3, 3)], [self._cell(0, 0)], [self._cell(6, 5)], []], [self._cell(6, 10)])
+        e.rank_rewards = [101, 101, 101, 101]
+        e.last_actions = {0: 0}
+        e.step({0: 1, 1: 0, 2: 0})  # goose 0 reverses and dies
+        assert e.rank_rewards[0] == 101          # frozen at pre-death value
+        assert e.rank_rewards[1] == 2 * 100 + 1  # (t+1)*scale + len
+        # survival beats the dead goose in the final ranking
+        assert e.rank_rewards[1] > e.rank_rewards[0]
+
+    def test_food_respawns_to_min(self):
+        e = self._env()
+        self._setup(e, [[self._cell(3, 3)], [self._cell(0, 0)], [], []], [self._cell(3, 4)])
+        e.step({0: 3, 1: 0})  # eat the only food
+        assert len(e.food) == 2  # respawned to MIN_FOOD
+        occupied = {c for g in e.geese for c in g}
+        assert not (set(e.food) & occupied)
+
+    def test_episode_step_limit(self):
+        e = self._env()
+        self._setup(e, [[self._cell(0, 0)], [self._cell(3, 3)], [self._cell(5, 5)], []], [self._cell(6, 10)])
+        e.step_count = 198
+        e.step({0: 0, 1: 0, 2: 0})
+        assert e.terminal()  # 199 transitions = kaggle episodeSteps 200
+
+
 def test_observation_viewpoint_rotation():
     """Geister: White's observation is the 180-rotation of the board."""
     random.seed(4)
