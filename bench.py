@@ -178,16 +178,7 @@ def _train_bench(env_name: str, overrides, duration: float, n_devices: int):
     state = ctx.init_state(model.variables["params"])
     device_batches = [ctx.put_batch(_sample_batch(store, args)) for _ in range(4)]
 
-    flops = None
-    try:
-        # Lowered.cost_analysis() is an HLO-level estimate and does not
-        # install a second executable into the jit cache (no double compile)
-        ca = ctx._train_step.lower(state, device_batches[0], np.float32(1e-5)).cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops = float(ca.get("flops", 0.0)) or None
-    except Exception:
-        pass
+    flops = ctx.flops_per_step(state, device_batches[0])
 
     state, metrics = ctx.train_step(state, device_batches[0], 1e-5)  # compile
     jax.block_until_ready(metrics["total"])
@@ -246,17 +237,19 @@ def _generation_bench(env_name: str, overrides, duration: float, num_actors: int
 
     def actor(i):
         env = make_env(args["env"])
-        gen = Generator(env, args)
+
+        def count():
+            steps[i] += 1  # incremental: long episodes still register
+
+        gen = Generator(env, args, on_step=count)
         players = env.players()
         models = {p: engine.client() for p in players}
         gen_args = {"player": players, "model_id": {p: -1 for p in players}}
         while not stop.is_set():
             try:
-                ep = gen.generate(models, gen_args)
+                gen.generate(models, gen_args)
             except EngineStopped:
                 return
-            if ep is not None:
-                steps[i] += ep["steps"]
 
     threads = [threading.Thread(target=actor, args=(i,), daemon=True) for i in range(num_actors)]
     t0 = time.perf_counter()

@@ -237,8 +237,14 @@ class TrainContext:
         # init_state (replicated, or 'mp'-sharded kernels when the mesh has
         # a tensor-parallel axis), the batch enters 'dp'-sharded, and GSPMD
         # propagates — the gradient all-reduce over ICI falls out of the
-        # layout rather than being spelled as explicit collectives.
-        self._train_step = jax.jit(_step, donate_argnums=(0,))
+        # layout rather than being spelled as explicit collectives.  The
+        # state shardings are pinned on BOTH sides of the jit (bound lazily
+        # on the first state, _bind): without out_shardings the first call
+        # compiles against init_state's layout, returns compiler-chosen
+        # output shardings, and the second call silently recompiles — a
+        # hidden ~30s stall on TPU that round 2's bench exposed.
+        self._step_fn = _step
+        self._train_step = None
 
     def _fresh_put(self, tree):
         """Lay ``tree`` out on the mesh in NEW buffers.
@@ -251,10 +257,30 @@ class TrainContext:
         shardings = param_shardings(self.mesh, tree)
         return jax.jit(lambda t: t, out_shardings=shardings)(tree)
 
+    def _bind(self, state):
+        """Compile the train step with the state layout pinned on both sides
+        (in_shardings == out_shardings), so every call — including the first
+        — hits one executable."""
+        if self._train_step is None:
+            ss = param_shardings(self.mesh, state)
+            self._train_step = jax.jit(
+                self._step_fn,
+                donate_argnums=(0,),
+                in_shardings=(ss, self._batch_shard, self._replicated),
+                out_shardings=(ss, self._replicated),
+            )
+        return self._train_step
+
     def init_state(self, params) -> Dict[str, Any]:
         params = self._fresh_put(params)
-        # optimizer moments inherit the params' layout (zeros_like on device)
-        opt_state = jax.jit(self.tx.init)(params)
+        # optimizer moments inherit the params' layout (same shape-based
+        # 'mp' rule, pinned so the state enters _bind's layout exactly)
+        opt_state = jax.jit(
+            self.tx.init,
+            out_shardings=param_shardings(
+                self.mesh, jax.eval_shape(self.tx.init, params)
+            ),
+        )(params)
         return {
             "params": params,
             "opt_state": opt_state,
@@ -289,4 +315,18 @@ class TrainContext:
         return jax.device_put(batch, self._batch_shard)
 
     def train_step(self, state, device_batch, lr: float):
-        return self._train_step(state, device_batch, jnp.float32(lr))
+        return self._bind(state)(state, device_batch, jnp.float32(lr))
+
+    def flops_per_step(self, state, device_batch):
+        """HLO cost-analysis flops of one update (for MFU accounting); the
+        lowering shares the bound executable's signature, so it does not
+        install a second entry in the jit cache."""
+        try:
+            ca = self._bind(state).lower(
+                state, device_batch, jnp.float32(1e-5)
+            ).cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            return float(ca.get("flops", 0.0)) or None
+        except Exception:
+            return None

@@ -25,9 +25,10 @@ from .replay import compress_block
 
 
 class Generator:
-    def __init__(self, env, args: Dict[str, Any]):
+    def __init__(self, env, args: Dict[str, Any], on_step=None):
         self.env = env
         self.args = args
+        self.on_step = on_step  # called once per env step (throughput probes)
 
     def generate(self, models: Dict[int, Any], args: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         env = self.env
@@ -47,6 +48,7 @@ class Generator:
             observers = env.observers()
             actions: Dict[int, Optional[int]] = {}
 
+            active = []
             for player in players:
                 if player not in turn_players and player not in observers:
                     continue
@@ -56,9 +58,24 @@ class Generator:
                     and not self.args["observation"]
                 ):
                     continue
+                active.append((player, env.observation(player)))
 
-                obs = env.observation(player)
-                outputs = models[player].inference(obs, hidden[player])
+            # issue every player's request before waiting on any: engine-
+            # backed models (inference_engine.py) expose ``submit`` and
+            # coalesce the concurrent requests into one device batch —
+            # simultaneous-move games (HungryGeese: 4 players/step) would
+            # otherwise pay one engine round-trip per player per step
+            futures = {
+                p: models[p].submit(o, hidden[p])
+                for p, o in active
+                if hasattr(models[p], "submit")
+            }
+
+            for player, obs in active:
+                if player in futures:
+                    outputs = futures[player].result()
+                else:
+                    outputs = models[player].inference(obs, hidden[player])
                 hidden[player] = outputs.get("hidden")
                 row["obs"][player] = obs
                 if outputs.get("value") is not None:
@@ -78,6 +95,8 @@ class Generator:
 
             if env.step(actions):
                 return None
+            if self.on_step is not None:
+                self.on_step()
 
             reward = env.reward()
             for p in players:

@@ -51,6 +51,11 @@ class BatchedInferenceClient:
     def inference(self, obs, hidden=None) -> Dict[str, Any]:
         return self._engine.submit(obs, hidden).result()
 
+    def submit(self, obs, hidden=None) -> Future:
+        """Async request entry — lets a caller queue several players'
+        observations before blocking, so they land in one device batch."""
+        return self._engine.submit(obs, hidden)
+
 
 class BatchedInferenceEngine:
     """One device model serving many actor threads with batched inference."""
