@@ -101,6 +101,16 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError("train_args.burn_in_steps must be >= 0")
     if not 0.0 <= train["eval_rate"] <= 1.0:
         raise ValueError("train_args.eval_rate must be in [0, 1]")
+    if train["seq_attention"] not in ("auto", "flash", "einsum"):
+        raise ValueError(
+            f"train_args.seq_attention={train['seq_attention']!r} "
+            "not one of ('auto', 'flash', 'einsum')"
+        )
+    if train["compute_dtype"] not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"train_args.compute_dtype={train['compute_dtype']!r} "
+            "not one of ('float32', 'bfloat16')"
+        )
     if "env" not in args.get("env_args", {}):
         raise ValueError("env_args.env is required")
     return args

@@ -105,34 +105,18 @@ class CachedSelfAttention(nn.Module):
         if key_mask is None:
             key_mask = jnp.ones((B, T), x.dtype)
 
+        # one semantics, two executions: the O(T^2) einsum reference
+        # (masked_attention_reference — per-key masks, observed-age ALiBi,
+        # ring-window eviction, self always visible) or the O(T·blk)
+        # Pallas kernel golden-tested against it
+        # (tests/test_flash_attention.py::test_masked_flash_matches_reference)
         if use_flash:
-            # Pallas kernel with identical semantics (masks, observed-age
-            # ALiBi, ring eviction) — O(T·blk) memory instead of the O(T^2)
-            # score tensor; golden-tested against the einsum path below
-            from ..ops.flash_attention import masked_flash_attention
+            from ..ops.flash_attention import masked_flash_attention as attn_fn
+        else:
+            from ..ops.flash_attention import masked_attention_reference as attn_fn
 
-            out = masked_flash_attention(
-                q, k, v, key_mask, _alibi_slopes(H), window=S
-            ).reshape(B, T, H * Dh)
-            return nn.Dense(self.d_model, name="o")(out), None
-
-        c = jnp.cumsum(key_mask, axis=1)                                  # observed count
-        age = c[:, :, None] - c[:, None, :]                               # (B, Tq, Tk)
-        t_idx = jnp.arange(T)
-        causal = t_idx[:, None] >= t_idx[None, :]
-        valid = (
-            (key_mask[:, None, :] > 0)
-            & causal[None]
-            & (age < S)
-            & (age >= 0)
-        )
-        valid = valid | jnp.eye(T, dtype=bool)[None]                      # self always visible
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (Dh ** 0.5)
-        scores = scores - _alibi_slopes(H)[None, :, None, None] * age[:, None]
-        scores = jnp.where(valid[:, None], scores, NEG_INF)
-        attn = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(B, T, H * Dh)
-        return nn.Dense(self.d_model, name="o")(out), None
+        out = attn_fn(q, k, v, key_mask, _alibi_slopes(H), window=S)
+        return nn.Dense(self.d_model, name="o")(out.reshape(B, T, H * Dh)), None
 
 
 class TransformerNet(nn.Module):

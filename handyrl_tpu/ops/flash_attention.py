@@ -9,8 +9,11 @@ Two entry points:
   (models/transformer.py seq mode): per-key observation masks, ALiBi-style
   biases over *observed-step* ages, and ring-buffer eviction (keys older
   than ``window`` observed steps invisible), all evaluated inside the
-  kernel from streamed (B, T) mask/count rows — bit-compatible with the
-  exact einsum reference in ``CachedSelfAttention``.
+  kernel from streamed (B, T) mask/count rows.  Its exact einsum
+  counterpart is ``masked_attention_reference`` — the same function
+  ``CachedSelfAttention``'s einsum branch executes — and the two are
+  golden-tested against each other (forward + custom-VJP gradients) in
+  tests/test_flash_attention.py.
 
 Ring attention (ops/ring_attention.py) still carries its own softmax
 accumulators across ring steps and does not dispatch here.
@@ -291,7 +294,11 @@ def _masked_scores(q_c, kf, c_q, counts, key_mask, slopes, window, q0, scale):
     (B, H, C, T) biased+masked scores for query chunk starting at q0."""
     C = q_c.shape[1]
     T = kf.shape[1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q_c, kf) * scale
+    # fp32 accumulation out of the MXU regardless of input dtype: bf16
+    # operands keep the matmul at bf16 rate, scores/softmax stay accurate
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q_c, kf, preferred_element_type=jnp.float32
+    ) * scale
     age = c_q[:, :, None] - counts[:, None, :]                # (B, C, T)
     qpos = q0 + jnp.arange(C)
     kpos = jnp.arange(T)
@@ -442,12 +449,16 @@ masked_flash_attention.defvjp(_masked_fwd, _masked_bwd)
 
 
 def masked_attention_reference(q, k, v, key_mask, slopes, window: int = 1 << 30):
-    """Exact einsum counterpart of masked_flash_attention (golden tests)."""
+    """Exact einsum counterpart of masked_flash_attention — also the
+    production einsum branch (models/transformer.py CachedSelfAttention
+    seq mode).  q/k/v stay in their input dtype (bf16 operands keep both
+    matmuls at MXU bf16 rate); scores and softmax are fp32 via the
+    einsum's accumulation dtype."""
     B, T, H, D = q.shape
     counts = jnp.cumsum(key_mask.astype(jnp.float32), axis=1)
     s, valid = _masked_scores(
-        q.astype(jnp.float32), k.astype(jnp.float32), counts, counts,
+        q, k, counts, counts,
         key_mask, slopes.astype(jnp.float32), window, 0, 1.0 / (D ** 0.5),
     )
-    attn = jax.nn.softmax(s, axis=-1) * valid[:, None].astype(jnp.float32)
-    return jnp.einsum("bhqk,bkhd->bqhd", attn, v.astype(jnp.float32)).astype(q.dtype)
+    attn = (jax.nn.softmax(s, axis=-1) * valid[:, None]).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", attn, v)
