@@ -15,6 +15,7 @@ The output names/treedef ride inside the serialized jax.export blob.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional
 
 import jax
@@ -68,7 +69,23 @@ def export_model(module, variables, sample_obs, path: str) -> None:
         f.write(blob)
 
 
-class ExportedModel(SingleInferenceMixin):
+class _ArtifactModel(SingleInferenceMixin):
+    """Shared base for deployed artifacts: hidden state is stored with a
+    leading batch axis of 1 (``self._hidden0``); ``init_hidden`` strips or
+    broadcasts it."""
+
+    _hidden0: Optional[Any] = None
+
+    def init_hidden(self, batch_dims=()):
+        if self._hidden0 is None:
+            return None
+        flat = tree_map(lambda x: x[0], self._hidden0)
+        if not batch_dims:
+            return flat
+        return tree_map(lambda x: np.broadcast_to(x, tuple(batch_dims) + x.shape).copy(), flat)
+
+
+class ExportedModel(_ArtifactModel):
     """Inference over a serialized artifact; same API as InferenceModel.
 
     Role of the reference's OnnxModel (evaluation.py:287-353): standalone
@@ -83,15 +100,6 @@ class ExportedModel(SingleInferenceMixin):
         self._exported = jax.export.deserialize(bytearray(data["mlir"]))
         self._hidden0 = data["hidden0"]
 
-    def init_hidden(self, batch_dims=()):
-        if self._hidden0 is None:
-            return None
-        # stored with a leading batch axis of 1; strip it for per-sample use
-        flat = tree_map(lambda x: x[0], self._hidden0)
-        if not batch_dims:
-            return flat
-        return tree_map(lambda x: np.broadcast_to(x, tuple(batch_dims) + x.shape).copy(), flat)
-
     def inference_batch(self, obs, hidden=None) -> Dict[str, Any]:
         obs = tree_map(jnp.asarray, obs)
         if self._hidden0 is None:
@@ -102,3 +110,112 @@ class ExportedModel(SingleInferenceMixin):
                 hidden = self.init_hidden((n,))
             outputs = self._exported.call(obs, tree_map(jnp.asarray, hidden))
         return jax.device_get(outputs)
+
+
+# -- TF SavedModel bridge (non-JAX runtimes) --------------------------------
+
+def export_savedmodel(module, variables, sample_obs, path: str) -> None:
+    """Freeze (module, variables) into a TF SavedModel via jax2tf.
+
+    The bridge artifact for runtimes outside JAX — TF Serving, TFLite,
+    or ONNX via the standard tf2onnx converter where installed — covering
+    the deployment role of the reference's ONNX export
+    (scripts/make_onnx_model.py:28-58).  Naming parity with the reference
+    (``input.N``/``hidden.N`` discovered by prefix, evaluation.py:335-344):
+    observation pytree leaves flatten to ``input_N``, hidden-state leaves
+    to ``hidden_N`` (jax.tree order), outputs to their dict keys plus
+    ``hidden_N`` for the next-step state.  Batch dimension is polymorphic.
+    """
+    import tensorflow as tf
+    from jax.experimental import jax2tf
+
+    hidden0 = module.initial_state((1,))
+    obs_b = tree_map(lambda x: np.asarray(x)[None], sample_obs)
+    obs_leaves, obs_tree = jax.tree.flatten(obs_b)
+    hid_leaves, hid_tree = jax.tree.flatten(hidden0)  # [] / None when stateless
+
+    def fn(*leaves):
+        obs = jax.tree.unflatten(obs_tree, leaves[: len(obs_leaves)])
+        hidden = (
+            jax.tree.unflatten(hid_tree, leaves[len(obs_leaves):])
+            if hid_leaves
+            else None
+        )
+        out = module.apply(variables, obs, hidden)
+        flat = {k: v for k, v in out.items() if k != "hidden" and v is not None}
+        for i, leaf in enumerate(jax.tree.leaves(out.get("hidden"))):
+            flat[f"hidden_{i}"] = leaf
+        return flat
+
+    def poly(x):
+        return "(" + ", ".join(["b"] + ["_"] * (np.asarray(x).ndim - 1)) + ")"
+
+    def tf_spec(x, name):
+        x = np.asarray(x)
+        return tf.TensorSpec([None] + list(x.shape[1:]), x.dtype, name=name)
+
+    leaves = list(obs_leaves) + list(hid_leaves)
+    names = [f"input_{i}" for i in range(len(obs_leaves))] + [
+        f"hidden_{i}" for i in range(len(hid_leaves))
+    ]
+    converted = jax2tf.convert(
+        fn, polymorphic_shapes=[poly(l) for l in leaves], with_gradient=False
+    )
+    m = tf.Module()
+    m.f = tf.function(
+        converted,
+        input_signature=[tf_spec(l, n) for l, n in zip(leaves, names)],
+        autograph=False,
+    )
+    # keep the pytree structure + initial hidden alongside the graph so the
+    # loader can rebuild framework-shaped inputs/outputs
+    from ..runtime import codec
+
+    os.makedirs(path, exist_ok=True)
+    tf.saved_model.save(m, path)
+    meta = {
+        "n_obs": len(obs_leaves),
+        "hidden0": None if hidden0 is None else tree_map(np.asarray, hidden0),
+    }
+    with open(os.path.join(path, "handyrl_meta.bin"), "wb") as f:
+        f.write(codec.dumps(meta))
+
+
+class SavedModelModel(_ArtifactModel):
+    """Inference over an exported TF SavedModel; same API as InferenceModel.
+
+    TF-runtime twin of ``ExportedModel`` — the reference's OnnxModel role
+    (evaluation.py:287-353) for deployments that run TF, not JAX.
+    """
+
+    def __init__(self, path: str):
+        import tensorflow as tf
+
+        from ..runtime import codec
+
+        self._tf = tf
+        self._loaded = tf.saved_model.load(path)
+        with open(os.path.join(path, "handyrl_meta.bin"), "rb") as f:
+            meta = codec.loads(f.read())
+        self._n_obs = int(meta["n_obs"])
+        self._hidden0 = meta["hidden0"]
+
+    def inference_batch(self, obs, hidden=None) -> Dict[str, Any]:
+        obs_leaves = jax.tree.leaves(tree_map(np.asarray, obs))
+        if len(obs_leaves) != self._n_obs:
+            raise ValueError(
+                f"observation pytree has {len(obs_leaves)} leaves; the "
+                f"artifact was exported for {self._n_obs}"
+            )
+        if self._hidden0 is not None and hidden is None:
+            hidden = self.init_hidden((obs_leaves[0].shape[0],))
+        hid_leaves = jax.tree.leaves(tree_map(np.asarray, hidden)) if hidden is not None else []
+        out = self._loaded.f(*[self._tf.constant(l) for l in obs_leaves + hid_leaves])
+        out = {k: np.asarray(v) for k, v in out.items()}
+        hid_names = sorted(
+            (k for k in out if k.startswith("hidden_")), key=lambda k: int(k[7:])
+        )
+        if hid_names:
+            _, hid_tree = jax.tree.flatten(self._hidden0)
+            out["hidden"] = jax.tree.unflatten(hid_tree, [out.pop(k) for k in hid_names])
+        return out

@@ -34,9 +34,10 @@ from __future__ import annotations
 import random
 from typing import Any, Dict, List
 
+import jax
 import numpy as np
 
-from ..utils import tree_concat, tree_map, tree_stack
+from ..utils import tree_concat, tree_map
 from .replay import decompress_block
 
 
@@ -92,52 +93,87 @@ def _assemble_one(window: Dict[str, Any], args: Dict[str, Any]) -> Dict[str, Any
     outcome = np.asarray(window["outcome"], dtype=np.float32)[target_players].reshape(1, -1, 1)
 
     steps = hi - lo
-    emask = np.ones((steps, 1, 1), dtype=np.float32)
     progress = (np.arange(window["start"], window["end"], dtype=np.float32) / window["total"])[:, None]
 
     prob = prob[..., None]
     action = action[..., None].astype(np.int32)
 
-    batch_steps = args["burn_in_steps"] + args["forward_steps"]
-    if steps < batch_steps:
+    pad_b = 0
+    if steps < args["burn_in_steps"] + args["forward_steps"]:
         pad_b = args["burn_in_steps"] - (window["train_start"] - window["start"])
-        pad_a = batch_steps - steps - pad_b
-
-        def pad(x, value=0.0):
-            width = [(pad_b, pad_a)] + [(0, 0)] * (x.ndim - 1)
-            return np.pad(x, width, constant_values=value)
-
-        obs = tree_map(pad, obs)
-        prob = pad(prob, 1.0)
-        action = pad(action, 0)
-        amask = pad(amask, 1e32)
-        # value: zero before the window, frozen at the outcome after the end
-        value = np.concatenate(
-            [np.pad(value, [(pad_b, 0), (0, 0), (0, 0)]), np.tile(outcome, (pad_a, 1, 1))]
-        )
-        reward = pad(reward)
-        ret = pad(ret)
-        tmask = pad(tmask)
-        omask = pad(omask)
-        emask = pad(emask)
-        progress = pad(progress, 1.0)
 
     return {
-        "observation": obs,
-        "selected_prob": prob.astype(np.float32),
-        "value": value.astype(np.float32),
+        "pad_b": pad_b,
+        "steps": steps,
+        "obs": obs,
+        "prob": prob,
+        "value": value,
         "action": action,
         "outcome": outcome,
-        "reward": reward.astype(np.float32),
-        "return": ret.astype(np.float32),
-        "episode_mask": emask,
-        "turn_mask": tmask,
-        "observation_mask": omask,
-        "action_mask": amask.astype(np.float32),
-        "progress": progress.astype(np.float32),
+        "reward": reward,
+        "ret": ret,
+        "tmask": tmask,
+        "omask": omask,
+        "amask": amask,
+        "progress": progress,
     }
 
 
 def make_batch(windows: List[Dict[str, Any]], args: Dict[str, Any]) -> Dict[str, Any]:
-    """Assemble B sampled windows into one (B, T, P, ...) numpy batch."""
-    return tree_stack([_assemble_one(w, args) for w in windows])
+    """Assemble B sampled windows into one (B, T, P, ...) numpy batch.
+
+    Each window writes its unpadded slice directly into preallocated
+    output arrays whose defaults ARE the padding semantics (zeros before
+    the window; after episode end selected_prob 1, action_mask all-illegal
+    1e32, value frozen at the outcome, progress 1, episode_mask 0) — one
+    allocation + one copy per key instead of the np.pad-per-array +
+    tree_stack version this replaces, which dominated the host-side batch
+    assembly profile and starved the learner on HungryGeese-sized
+    observations.
+    """
+    B = len(windows)
+    T = args["burn_in_steps"] + args["forward_steps"]
+    cores = [_assemble_one(w, args) for w in windows]
+    c0 = cores[0]
+
+    def alloc(leaf, fill=0.0, dtype=np.float32):
+        shape = (B, T) + tuple(leaf.shape[1:])
+        if fill == 0.0:
+            return np.zeros(shape, dtype)
+        return np.full(shape, fill, dtype)
+
+    out = {
+        "observation": tree_map(lambda x: alloc(x, 0.0, x.dtype), c0["obs"]),
+        "selected_prob": alloc(c0["prob"], 1.0),
+        "value": alloc(c0["value"]),
+        "action": alloc(c0["action"], 0, np.int32),
+        "outcome": np.zeros((B, 1) + tuple(c0["outcome"].shape[1:]), np.float32),
+        "reward": alloc(c0["reward"]),
+        "return": alloc(c0["ret"]),
+        "episode_mask": np.zeros((B, T, 1, 1), np.float32),
+        "turn_mask": alloc(c0["tmask"]),
+        "observation_mask": alloc(c0["omask"]),
+        "action_mask": alloc(c0["amask"], 1e32),
+        "progress": alloc(c0["progress"], 1.0),
+    }
+
+    for b, c in enumerate(cores):
+        lo, hi = c["pad_b"], c["pad_b"] + c["steps"]
+        sl = slice(lo, hi)
+        for dst, leaf in zip(
+            jax.tree.leaves(out["observation"]), jax.tree.leaves(c["obs"])
+        ):
+            dst[b, sl] = leaf
+        out["selected_prob"][b, sl] = c["prob"]
+        out["value"][b, sl] = c["value"]
+        out["value"][b, hi:] = c["outcome"]  # frozen at outcome past the end
+        out["action"][b, sl] = c["action"]
+        out["outcome"][b] = c["outcome"]
+        out["reward"][b, sl] = c["reward"]
+        out["return"][b, sl] = c["ret"]
+        out["episode_mask"][b, sl] = 1.0
+        out["turn_mask"][b, sl] = c["tmask"]
+        out["observation_mask"][b, sl] = c["omask"]
+        out["action_mask"][b, sl] = c["amask"]
+        out["progress"][b, sl] = c["progress"]
+    return out

@@ -104,15 +104,20 @@ def build_agent(raw: Any, env=None) -> Optional[Any]:
 
 
 def load_model_agent(model_path: str, env, module=None) -> Agent:
-    """Checkpoint (.ckpt) or exported StableHLO (.hlo) path -> greedy Agent.
+    """Checkpoint (.ckpt), exported StableHLO (.hlo) or TF SavedModel
+    (.tf directory) path -> greedy Agent.
 
     Mirrors reference load_model dispatch (.pth vs .onnx,
-    evaluation.py:356-365); .hlo artifacts need no model code.
+    evaluation.py:356-365); exported artifacts need no model code.
     """
     if model_path.endswith(".hlo"):
         from ..models.export import ExportedModel
 
         return Agent(ExportedModel(model_path))
+    if model_path.endswith(".tf"):
+        from ..models.export import SavedModelModel
+
+        return Agent(SavedModelModel(model_path))
     from ..models import init_variables
 
     module = module or env.net()

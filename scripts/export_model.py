@@ -9,6 +9,10 @@ path) without the model's python code.
 Usage:
     python scripts/export_model.py <ckpt_path> [out_path]
 
+``out_path`` ending in ``.tf`` writes a TF SavedModel via jax2tf instead
+(deployable to TF Serving / TFLite, convertible to ONNX with tf2onnx) —
+the bridge for non-JAX runtimes.
+
 Reads env from ./config.yaml (like the reference reads config.yaml for
 the env to export).
 """
@@ -41,7 +45,13 @@ def main() -> None:
     variables = init_variables(module, env)
     params = load_params(ckpt, variables["params"])
     env.reset()
-    export_model(module, {"params": params}, env.observation(env.players()[0]), out)
+    obs = env.observation(env.players()[0])
+    if out.endswith(".tf"):  # TF SavedModel bridge (TFLite / tf2onnx / TF Serving)
+        from handyrl_tpu.models.export import export_savedmodel
+
+        export_savedmodel(module, {"params": params}, obs, out)
+    else:
+        export_model(module, {"params": params}, obs, out)
     print(f"exported {ckpt} -> {out}")
 
 

@@ -9,6 +9,7 @@ import os
 import subprocess
 import sys
 
+import jax
 import numpy as np
 import pytest
 
@@ -150,3 +151,35 @@ def test_plot_scripts(script, tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
     assert out.exists() and out.stat().st_size > 1000
+
+
+@pytest.mark.parametrize("env_name", ["TicTacToe", "Geister"])
+def test_savedmodel_roundtrip(env_name, tmp_path):
+    """jax2tf SavedModel bridge: outputs (incl. recurrent hidden) match the
+    live model, and the batch dimension stays polymorphic."""
+    pytest.importorskip("tensorflow")
+    from handyrl_tpu.models.export import SavedModelModel, export_savedmodel
+    from handyrl_tpu.utils import tree_map, tree_stack
+
+    env, module, variables, model = _model(env_name)
+    env.reset()
+    obs = env.observation(env.players()[0])
+    path = str(tmp_path / f"{env_name}.tf")
+    export_savedmodel(module, variables, obs, path)
+
+    sm = SavedModelModel(path)
+    o1 = model.inference(obs, model.init_hidden())
+    o2 = sm.inference(obs, sm.init_hidden())
+    np.testing.assert_allclose(o1["policy"], o2["policy"], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(o1["value"], o2["value"], rtol=1e-4, atol=1e-5)
+    if o1.get("hidden") is not None:
+        for a, b in zip(
+            jax.tree.leaves(o1["hidden"]), jax.tree.leaves(o2["hidden"])
+        ):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    obs_b = tree_stack([obs, obs, obs])
+    hidden = sm.init_hidden()
+    hidden_b = None if hidden is None else tree_stack([hidden] * 3)
+    out = sm.inference_batch(obs_b, hidden_b)
+    assert np.asarray(out["policy"]).shape[0] == 3
