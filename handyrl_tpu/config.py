@@ -55,6 +55,10 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     },
     "inference_batch_size": 64,
     "prefetch_batches": 2,
+    # k SGD updates fused under one lax.scan per device call (amortizes
+    # per-call dispatch for small models); 1 = one jit call per update.
+    # Semantics are identical: lr is already held constant within an epoch.
+    "fused_steps": 1,
     "metrics_path": "metrics.jsonl",
     "model_dir": "models",
     "battle_port": 9876,
@@ -99,6 +103,8 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
             raise ValueError(f"train_args.{key} must be positive, got {train[key]}")
     if train["burn_in_steps"] < 0:
         raise ValueError("train_args.burn_in_steps must be >= 0")
+    if train["fused_steps"] < 1:
+        raise ValueError("train_args.fused_steps must be >= 1")
     if not 0.0 <= train["eval_rate"] <= 1.0:
         raise ValueError("train_args.eval_rate must be in [0, 1]")
     if train["seq_attention"] not in ("auto", "flash", "einsum"):

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.sharding import NamedSharding, PartitionSpec
 
 from ..ops import compute_loss_from_outputs
 from ..utils import tree_map
@@ -244,7 +245,21 @@ class TrainContext:
         # output shardings, and the second call silently recompiles — a
         # hidden ~30s stall on TPU that round 2's bench exposed.
         self._step_fn = _step
+
+        def _steps(state, batches, lr):
+            """k SGD updates under one lax.scan — one dispatch, one
+            executable; metrics come back summed over the k steps (the
+            trainer accumulates sums anyway).  Bit-identical to k separate
+            calls: same op order per step, same (held-per-epoch) lr."""
+            def body(s, b):
+                return _step(s, b, lr)
+
+            state, metrics = jax.lax.scan(body, state, batches)
+            return state, jax.tree.map(lambda m: m.sum(axis=0), metrics)
+
+        self._steps_fn = _steps
         self._train_step = None
+        self._train_steps = None
 
     def _fresh_put(self, tree):
         """Lay ``tree`` out on the mesh in NEW buffers.
@@ -316,6 +331,37 @@ class TrainContext:
 
     def train_step(self, state, device_batch, lr: float):
         return self._bind(state)(state, device_batch, jnp.float32(lr))
+
+    def put_batches(self, host_batches):
+        """Stack k host batches -> one (k, B, ...) device tree, B sharded
+        over 'dp' (axis 1), for the fused train_steps path.  Mirrors
+        put_batch: under jax.distributed each process contributes its
+        LOCAL (k, B/process_count, ...) shard."""
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *host_batches)
+        shard = NamedSharding(self.mesh, PartitionSpec(None, "dp"))
+        if jax.process_count() > 1:
+            return jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(shard, np.asarray(x)),
+                stacked,
+            )
+        B = host_batches[0]["action"].shape[0]
+        dp = self.mesh.shape.get("dp", 1)
+        if B % dp != 0:
+            raise ValueError(f"batch size {B} not divisible by dp axis {dp}")
+        return jax.device_put(stacked, shard)
+
+    def train_steps(self, state, stacked_device_batch, lr: float):
+        """k fused updates (see _steps); input from put_batches."""
+        if self._train_steps is None:
+            ss = param_shardings(self.mesh, state)
+            stacked_shard = NamedSharding(self.mesh, PartitionSpec(None, "dp"))
+            self._train_steps = jax.jit(
+                self._steps_fn,
+                donate_argnums=(0,),
+                in_shardings=(ss, stacked_shard, self._replicated),
+                out_shardings=(ss, self._replicated),
+            )
+        return self._train_steps(state, stacked_device_batch, jnp.float32(lr))
 
     def flops_per_step(self, state, device_batch):
         """HLO cost-analysis flops of one update (for MFU accounting); the

@@ -105,11 +105,21 @@ class BatchPipeline:
 
     def _device_put_loop(self):
         try:
+            fused = self.args.get("fused_steps", 1)
             while not self.stop_event.is_set():
-                batch = self._get(self._host_queue)
-                if batch is None:
-                    return
-                self._put(self._device_queue, self.ctx.put_batch(batch))
+                if fused > 1:
+                    group = []
+                    while len(group) < fused:
+                        batch = self._get(self._host_queue)
+                        if batch is None:  # stop_event or shutdown sentinel
+                            return
+                        group.append(batch)
+                    self._put(self._device_queue, self.ctx.put_batches(group))
+                else:
+                    batch = self._get(self._host_queue)
+                    if batch is None:
+                        return
+                    self._put(self._device_queue, self.ctx.put_batch(batch))
         except Exception:
             traceback.print_exc()
             self.stop_event.set()
@@ -206,16 +216,20 @@ class Trainer:
         lr = self.lr
         wait_s = 0.0
         t_epoch = time.perf_counter()
+        fused = self.args.get("fused_steps", 1)
         while data_cnt == 0 or not self.update_flag:
             t0 = time.perf_counter()
             batch = self.batcher.batch()
             wait_s += time.perf_counter() - t0  # input starvation (north-star)
             if batch is None:  # shutting down
                 break
-            self.state, metrics = self.ctx.train_step(self.state, batch, lr)
+            if fused > 1:  # k updates per device call, metrics pre-summed
+                self.state, metrics = self.ctx.train_steps(self.state, batch, lr)
+            else:
+                self.state, metrics = self.ctx.train_step(self.state, batch, lr)
             metric_accum.append(metrics)
-            batch_cnt += 1
-            self.steps += 1
+            batch_cnt += fused
+            self.steps += fused
             data_cnt = 1  # real count resolved below without device sync per step
         if not metric_accum:
             return self.state_host["params"]
