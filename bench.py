@@ -157,7 +157,8 @@ def _sample_batch(store, args):
     return make_batch(windows, args)
 
 
-def _train_bench(env_name: str, overrides, duration: float, n_devices: int):
+def _train_bench(env_name: str, overrides, duration: float, n_devices: int,
+                 fill_episodes: int = 48):
     """Timed jitted-train-step loop on pre-staged device batches.
 
     Returns updates/s, trained env-steps/s, flops/step (XLA cost analysis)."""
@@ -170,7 +171,7 @@ def _train_bench(env_name: str, overrides, duration: float, n_devices: int):
         args["batch_size"] = max(n_devices, args["batch_size"] // n_devices * n_devices)
 
     _note(f"{env_name}: generating episodes for the replay store")
-    _, module, model, store = _fill_store(args, 16 if QUICK else 64)
+    _, module, model, store = _fill_store(args, 12 if QUICK else fill_episodes)
     _note(f"{env_name}: store filled; compiling + timing the train step")
 
     mesh = make_mesh(args["mesh"])
@@ -424,7 +425,28 @@ def main() -> None:
     except Exception:
         result["error"] = (result["error"] or "") + " geese-train: " + traceback.format_exc(limit=3)
 
-    # 4. seq-attention kernel crossover (einsum vs Pallas flash, fwd+bwd)
+    # 4. recurrent path: Geister DRC ConvLSTM with burn-in + UPGO — the
+    # long-horizon imperfect-info config (BASELINE.json configs[3]); the
+    # train step here is a T-step lax.scan with masked hidden carry
+    try:
+        geister = _train_bench(
+            "Geister",
+            {"burn_in_steps": 8, "forward_steps": 16, "observation": True,
+             "policy_target": "UPGO", "value_target": "UPGO"},
+            T_TRAIN,
+            len(devices),
+            fill_episodes=12,  # 200-turn episodes; filling dominates otherwise
+        )
+        result["extra"]["geister_rnn_updates_per_sec"] = round(
+            geister["updates_per_sec"], 2
+        )
+        result["extra"]["geister_rnn_trained_env_steps_per_sec"] = round(
+            geister["trained_env_steps_per_sec"], 1
+        )
+    except Exception:
+        result["error"] = (result["error"] or "") + " geister: " + traceback.format_exc(limit=3)
+
+    # 5. seq-attention kernel crossover (einsum vs Pallas flash, fwd+bwd)
     try:
         import jax
 

@@ -249,8 +249,11 @@ class TrainContext:
         def _steps(state, batches, lr):
             """k SGD updates under one lax.scan — one dispatch, one
             executable; metrics come back summed over the k steps (the
-            trainer accumulates sums anyway).  Bit-identical to k separate
-            calls: same op order per step, same (held-per-epoch) lr."""
+            trainer accumulates sums anyway).  Semantically identical to k
+            separate calls with the same (held-per-epoch) lr; numerically
+            equivalent only up to float reassociation, since XLA fuses the
+            scan body differently than the unrolled step (pinned at
+            rtol 1e-5 by tests/test_training.py)."""
             def body(s, b):
                 return _step(s, b, lr)
 
@@ -316,39 +319,33 @@ class TrainContext:
         process_count rows); every process assembles its own shard and the
         global array is built with make_array_from_process_local_data —
         no cross-host batch traffic."""
-        if jax.process_count() > 1:
-            return jax.tree.map(
-                lambda x: jax.make_array_from_process_local_data(
-                    self._batch_shard, np.asarray(x)
-                ),
-                batch,
-            )
-        B = batch["action"].shape[0]
-        dp = self.mesh.shape.get("dp", 1)
-        if B % dp != 0:
-            raise ValueError(f"batch size {B} not divisible by dp axis {dp}")
-        return jax.device_put(batch, self._batch_shard)
+        return self._put_sharded(batch, self._batch_shard, batch["action"].shape[0])
 
     def train_step(self, state, device_batch, lr: float):
         return self._bind(state)(state, device_batch, jnp.float32(lr))
 
     def put_batches(self, host_batches):
         """Stack k host batches -> one (k, B, ...) device tree, B sharded
-        over 'dp' (axis 1), for the fused train_steps path.  Mirrors
-        put_batch: under jax.distributed each process contributes its
-        LOCAL (k, B/process_count, ...) shard."""
+        over 'dp' (axis 1), for the fused train_steps path."""
         stacked = jax.tree.map(lambda *xs: np.stack(xs), *host_batches)
         shard = NamedSharding(self.mesh, PartitionSpec(None, "dp"))
+        return self._put_sharded(stacked, shard, host_batches[0]["action"].shape[0])
+
+    def _put_sharded(self, tree, shard, B: int):
+        """Lay a host tree out under ``shard``.  Single-process: one
+        device_put (with a clear dp-divisibility error).  Multi-process
+        (jax.distributed): ``tree`` is this process's LOCAL shard
+        (global_batch / process_count rows) and the global array is built
+        with make_array_from_process_local_data — no cross-host traffic."""
         if jax.process_count() > 1:
             return jax.tree.map(
                 lambda x: jax.make_array_from_process_local_data(shard, np.asarray(x)),
-                stacked,
+                tree,
             )
-        B = host_batches[0]["action"].shape[0]
         dp = self.mesh.shape.get("dp", 1)
         if B % dp != 0:
             raise ValueError(f"batch size {B} not divisible by dp axis {dp}")
-        return jax.device_put(stacked, shard)
+        return jax.device_put(tree, shard)
 
     def train_steps(self, state, stacked_device_batch, lr: float):
         """k fused updates (see _steps); input from put_batches."""

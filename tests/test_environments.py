@@ -14,7 +14,15 @@ import pytest
 
 from handyrl_tpu.envs import make_env
 
-ENV_NAMES = ["TicTacToe", "ParallelTicTacToe", "Geister", "HungryGeese"]
+ENV_NAMES = [
+    "TicTacToe",
+    "ParallelTicTacToe",
+    "Geister",
+    "HungryGeese",
+    # dotted-path custom env (docs/custom_environment.md): the example
+    # Connect Four exercises the registry fallback the way a user would
+    "examples.connect_four",
+]
 
 
 def _make(name):
