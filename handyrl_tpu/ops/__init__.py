@@ -3,6 +3,8 @@ from .losses import compute_loss_from_outputs
 from .flash_attention import flash_attention
 from .ring_attention import (
     full_attention_reference,
+    masked_ring_attention_shard,
+    masked_ring_self_attention,
     ring_attention_shard,
     ring_self_attention,
 )
@@ -13,5 +15,7 @@ __all__ = [
     "flash_attention",
     "ring_attention_shard",
     "ring_self_attention",
+    "masked_ring_attention_shard",
+    "masked_ring_self_attention",
     "full_attention_reference",
 ]

@@ -289,9 +289,11 @@ def _masked_flash_kernel(
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
 
 
-def _masked_scores(q_c, kf, c_q, counts, key_mask, slopes, window, q0, scale):
-    """Shared forward/backward score construction on an einsum slab:
-    (B, H, C, T) biased+masked scores for query chunk starting at q0."""
+def _masked_scores(q_c, kf, c_q, counts, key_mask, slopes, window, q0, scale, k0=0):
+    """THE seq-mode attention semantics, as one score construction shared
+    by every execution (einsum reference, Pallas kernel backward, masked
+    ring shard): (B, H, C, T) biased+masked scores for a query chunk at
+    global position ``q0`` against keys at global position ``k0``."""
     C = q_c.shape[1]
     T = kf.shape[1]
     # fp32 accumulation out of the MXU regardless of input dtype: bf16
@@ -301,7 +303,7 @@ def _masked_scores(q_c, kf, c_q, counts, key_mask, slopes, window, q0, scale):
     ) * scale
     age = c_q[:, :, None] - counts[:, None, :]                # (B, C, T)
     qpos = q0 + jnp.arange(C)
-    kpos = jnp.arange(T)
+    kpos = k0 + jnp.arange(T)
     valid = (
         (key_mask[:, None, :] > 0)
         & (qpos[:, None] >= kpos[None, :])[None]

@@ -52,19 +52,22 @@ def _block_attention(q, k, v, q_off, k_off, scale, causal):
     return o, m, l
 
 
-def ring_attention_shard(q, k, v, axis_name: str, causal: bool = True, vary_axes=()):
-    """Per-shard ring attention body; call inside shard_map.
+def _ring_loop(q, k, v, extras, axis_name: str, scores_fn, vary_axes=()):
+    """Shared ring mechanics: each participant holds contiguous time
+    shards of equal length (shard i owns positions [i*T_loc, (i+1)*T_loc));
+    K/V (and any ``extras`` keyed to the K shard) rotate to the next device
+    every step via ppermute, so after n steps every Q shard has seen every
+    K/V shard; blocks merge through a streaming (flash-style) softmax.
 
-    Each participant holds contiguous time shards of equal length; shard i
-    owns positions [i*T_loc, (i+1)*T_loc).  K/V rotate to the next device
-    every step so after n steps every Q shard has seen every K/V shard.
+    ``scores_fn(qf, kf, extras, q0, k0) -> (B, H, Tq, Tk)`` builds the
+    (masked/biased) scores for one block — the only part that differs
+    between the causal and the production masked semantics.
     ``vary_axes`` lists any additional manual mesh axes in scope (e.g. a
     'dp' batch axis) so the accumulators carry the right varying type.
     """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, T_loc, H, D = q.shape
-    scale = 1.0 / (D ** 0.5)
     qf = q.astype(jnp.float32)
 
     # accumulators start replicated but become device-varying inside the
@@ -81,11 +84,20 @@ def ring_attention_shard(q, k, v, axis_name: str, causal: bool = True, vary_axes
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(i, carry):
-        o, m, l, k, v = carry
+        o, m, l, k, v, extras = carry
         k_idx = (idx - i) % n  # owner of the K/V block currently held
-        o_blk, m_blk, l_blk = _block_attention(
-            qf, k.astype(jnp.float32), v, idx * T_loc, k_idx * T_loc, scale, causal
-        )
+        s = scores_fn(qf, k.astype(jnp.float32), extras, idx * T_loc, k_idx * T_loc)
+        m_blk = s.max(axis=-1)                           # (B, H, Tq)
+        p = jnp.exp(s - m_blk[..., None])
+        l_blk = p.sum(axis=-1)
+        o_blk = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+        # NOTE on fully-invalid blocks (every score NEG_INF): m_blk is
+        # NEG_INF and p collapses to exp(0)=1 garbage, but ring step 0
+        # processes the query's OWN shard where self-visibility (causal
+        # diagonal / the masked 'self always visible' rule) guarantees a
+        # finite m — so for every later all-invalid block beta is
+        # exp(NEG_INF - finite) = 0 and the garbage never lands.
         m_new = jnp.maximum(m, m_blk)
         alpha = jnp.exp(m - m_new)                       # rescale old accum
         beta = jnp.exp(m_blk - m_new)                    # rescale new block
@@ -95,12 +107,31 @@ def ring_attention_shard(q, k, v, axis_name: str, causal: bool = True, vary_axes
         o = o * scale_old + o_blk.astype(jnp.float32) * scale_new
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
-        return o, m_new, l, k, v
+        extras = tuple(jax.lax.ppermute(e, axis_name, perm) for e in extras)
+        return o, m_new, l, k, v, extras
 
-    o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
+    o, m, l, _, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v, extras))
     l = jnp.maximum(l, 1e-30)                            # fully-masked rows -> 0
     out = o / jnp.moveaxis(l, 1, 2)[..., None]
     return out.astype(q.dtype)
+
+
+def ring_attention_shard(q, k, v, axis_name: str, causal: bool = True, vary_axes=()):
+    """Per-shard (plain causal/full) ring attention body; call inside
+    shard_map."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    def scores(qf, kf, extras, q0, k0):
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, kf, preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            qpos = q0 + jnp.arange(qf.shape[1])
+            kpos = k0 + jnp.arange(kf.shape[1])
+            s = jnp.where((qpos[:, None] >= kpos[None, :])[None, None], s, NEG_INF)
+        return s
+
+    return _ring_loop(q, k, v, (), axis_name, scores, vary_axes)
 
 
 def ring_self_attention(
@@ -132,6 +163,76 @@ def ring_self_attention(
         out_specs=spec,
     )
     return fn(q, k, v)
+
+
+def masked_ring_attention_shard(
+    q, k, v, key_mask, counts, slopes, axis_name: str,
+    window: float = float(1 << 30), vary_axes=(),
+):
+    """Ring attention with the transformer seq-mode semantics: per-key
+    observation masks, ALiBi bias over *observed-step* ages, ring-buffer
+    eviction of keys older than ``window`` observed steps, self always
+    visible — scores built by flash_attention._masked_scores, the single
+    shared semantics definition.
+
+    ``counts`` is the GLOBAL observed-count cumsum (computed over the full
+    T before sharding — ages are differences of global counts, so each
+    shard only needs its own slice).  key_mask/counts (B, T_loc) rotate
+    around the ring with their K/V shard.
+    """
+    from .flash_attention import _masked_scores  # circular at module level
+
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    c_q = counts  # this shard's queries' observed counts (B, T_loc)
+    slopes_f = slopes.astype(jnp.float32)
+
+    def scores(qf, kf, extras, q0, k0):
+        mask_k, c_k = extras
+        s, _ = _masked_scores(
+            qf, kf, c_q, c_k, mask_k, slopes_f, window, q0, scale, k0=k0
+        )
+        return s
+
+    # key_mask/counts are sharded shard_map inputs — already device-varying
+    return _ring_loop(q, k, v, (key_mask, counts), axis_name, scores, vary_axes)
+
+
+def masked_ring_self_attention(
+    q, k, v, key_mask, slopes,
+    mesh: Mesh,
+    window: int = 1 << 30,
+    seq_axis: str = "sp",
+    batch_axis: Optional[str] = "dp",
+):
+    """Sequence-parallel masked attention over ``mesh``: the transformer's
+    training attention (flash_attention.masked_attention_reference
+    semantics) with T sharded over ``seq_axis`` — long windows whose
+    K/V no longer fit one chip ride the ICI ring instead.
+
+    q/k/v (B, T, H, D); key_mask (B, T); slopes (H,).  The global
+    observed-count cumsum is taken here, before sharding.
+    """
+    if seq_axis not in mesh.shape or mesh.shape[seq_axis] == 1:
+        from .flash_attention import masked_attention_reference
+
+        return masked_attention_reference(q, k, v, key_mask, slopes, window=window)
+    counts = jnp.cumsum(key_mask.astype(jnp.float32), axis=1)
+
+    b_axis = batch_axis if batch_axis in mesh.shape else None
+    spec4 = P(b_axis, seq_axis, None, None)
+    spec2 = P(b_axis, seq_axis)
+    fn = shard_map(
+        functools.partial(
+            masked_ring_attention_shard,
+            axis_name=seq_axis,
+            window=float(window),
+            vary_axes=(b_axis,),
+        ),
+        mesh=mesh,
+        in_specs=(spec4, spec4, spec4, spec2, spec2, P(None)),
+        out_specs=spec4,
+    )
+    return fn(q, k, v, key_mask, counts, slopes)
 
 
 def full_attention_reference(q, k, v, causal: bool = True):

@@ -56,6 +56,25 @@ local = np.full((B_local, 3), pid + 1.0, np.float32)
 arr = jax.make_array_from_process_local_data(sharding, local)
 total = jax.jit(lambda x: x.sum(), out_shardings=NamedSharding(mesh, PartitionSpec()))(arr)
 
+# put_batches' multi-process branch (fused_steps path): stack k local
+# batch shards -> (k, B, ...) global tree, reduce through a collective
+from handyrl_tpu.config import normalize_args
+from handyrl_tpu.envs import make_env
+from handyrl_tpu.parallel import TrainContext
+
+cfg = normalize_args({"env_args": {"env": "TicTacToe"}, "train_args": {"batch_size": 4}})
+targs = dict(cfg["train_args"]); targs["env"] = cfg["env_args"]
+ctx = TrainContext(make_env(cfg["env_args"]).net(), targs, mesh)
+host_batches = [
+    {"action": np.full((B_local, 1), pid + 1.0, np.float32)} for _ in range(3)
+]
+stacked = ctx.put_batches(host_batches)
+ssum = jax.jit(
+    lambda t: t["action"].sum(), out_shardings=NamedSharding(mesh, PartitionSpec())
+)(stacked)
+# 3 stacked batches x (2 local rows x 1 col) x (1 + 2) across processes
+assert abs(float(ssum) - 18.0) < 1e-6, float(ssum)
+
 # the checkpoint/metrics guard: exactly one writer
 if is_coordinator():
     with open(os.path.join(outdir, "result.json"), "w") as f:
