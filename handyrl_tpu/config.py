@@ -67,7 +67,8 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     # set supports_seq); turn off to force the step-scan path
     "seq_forward": True,
     # seq-mode attention implementation: 'auto' (Pallas masked flash
-    # attention on TPU, einsum elsewhere), 'flash', or 'einsum'
+    # attention on TPU, einsum elsewhere), 'flash', 'einsum', or 'ring'
+    # (sequence-parallel masked ring attention — needs an 'sp' mesh axis)
     "seq_attention": "auto",
     # 'bfloat16' runs the forward/backward compute in bf16 (MXU rate)
     # with fp32 master weights; 'float32' is exact
@@ -107,10 +108,10 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError("train_args.fused_steps must be >= 1")
     if not 0.0 <= train["eval_rate"] <= 1.0:
         raise ValueError("train_args.eval_rate must be in [0, 1]")
-    if train["seq_attention"] not in ("auto", "flash", "einsum"):
+    if train["seq_attention"] not in ("auto", "flash", "einsum", "ring"):
         raise ValueError(
             f"train_args.seq_attention={train['seq_attention']!r} "
-            "not one of ('auto', 'flash', 'einsum')"
+            "not one of ('auto', 'flash', 'einsum', 'ring')"
         )
     if train["compute_dtype"] not in ("float32", "bfloat16"):
         raise ValueError(

@@ -67,7 +67,8 @@ class CachedSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, cache=None, slot=None, count=None, seq: bool = False,
-                 key_mask=None, burn_in: int = 0, use_flash: bool = False):
+                 key_mask=None, burn_in: int = 0, use_flash: bool = False,
+                 ring_mesh=None):
         H, S = self.n_heads, self.memory_len
         Dh = self.d_model // H
 
@@ -105,17 +106,26 @@ class CachedSelfAttention(nn.Module):
         if key_mask is None:
             key_mask = jnp.ones((B, T), x.dtype)
 
-        # one semantics, two executions: the O(T^2) einsum reference
+        # one semantics, three executions: the O(T^2) einsum reference
         # (masked_attention_reference — per-key masks, observed-age ALiBi,
-        # ring-window eviction, self always visible) or the O(T·blk)
-        # Pallas kernel golden-tested against it
-        # (tests/test_flash_attention.py::test_masked_flash_matches_reference)
-        if use_flash:
-            from ..ops.flash_attention import masked_flash_attention as attn_fn
-        else:
-            from ..ops.flash_attention import masked_attention_reference as attn_fn
+        # ring-window eviction, self always visible), the O(T·blk) Pallas
+        # kernel golden-tested against it
+        # (tests/test_flash_attention.py::test_masked_flash_matches_reference),
+        # or — when a mesh with an 'sp' axis is supplied — sequence-parallel
+        # masked ring attention sharding T across chips
+        if ring_mesh is not None:
+            from ..ops.ring_attention import masked_ring_self_attention
 
-        out = attn_fn(q, k, v, key_mask, _alibi_slopes(H), window=S)
+            out = masked_ring_self_attention(
+                q, k, v, key_mask, _alibi_slopes(H), ring_mesh, window=S
+            )
+        else:
+            if use_flash:
+                from ..ops.flash_attention import masked_flash_attention as attn_fn
+            else:
+                from ..ops.flash_attention import masked_attention_reference as attn_fn
+
+            out = attn_fn(q, k, v, key_mask, _alibi_slopes(H), window=S)
         return nn.Dense(self.d_model, name="o")(out.reshape(B, T, H * Dh)), None
 
 
@@ -139,7 +149,7 @@ class TransformerNet(nn.Module):
     @nn.compact
     def __call__(self, obs, hidden=None, train: bool = False, *,
                  seq: bool = False, key_mask=None, burn_in: int = 0,
-                 use_flash: bool = False):
+                 use_flash: bool = False, ring_mesh=None):
         if seq:
             x = nn.relu(nn.Dense(self.d_model, name="enc1")(_flatten_obs(obs, 2)))
             slot = count = None
@@ -167,6 +177,7 @@ class TransformerNet(nn.Module):
                 key_mask=key_mask,
                 burn_in=burn_in,
                 use_flash=use_flash,
+                ring_mesh=ring_mesh,
             )
             x = x + a
             h = nn.LayerNorm(name=f"ln_m{i}")(x)

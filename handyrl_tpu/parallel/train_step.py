@@ -99,12 +99,20 @@ def forward_prediction(module, params, batch: Dict[str, Any], args: Dict[str, An
         obs_bp = tree_map(to_bp, obs)                       # (B*P, T, ...)
         km = to_bp(omask)[..., 0]                           # (B*P, T)
         # seq_attention: 'einsum' (exact O(T^2) path), 'flash' (Pallas
-        # masked flash-attention kernel), or 'auto' (flash on TPU backends)
+        # masked flash-attention kernel), 'ring' (sequence-parallel masked
+        # ring attention over the mesh's 'sp' axis — args['_mesh'], set by
+        # TrainContext), or 'auto' (flash on TPU backends)
         mode = args.get("seq_attention", "auto")
         use_flash = mode == "flash" or (mode == "auto" and jax.default_backend() == "tpu")
+        ring_mesh = None
+        if mode == "ring":
+            # mesh shape + T divisibility are validated up front by
+            # TrainContext.__init__ (fail-fast); args['_mesh'] is set there
+            ring_mesh = args.get("_mesh")
+            use_flash = False
         outs = module.apply(
             {"params": params}, obs_bp, None, seq=True, key_mask=km,
-            burn_in=burn_in, use_flash=use_flash,
+            burn_in=burn_in, use_flash=use_flash, ring_mesh=ring_mesh,
         )
         outputs = {
             k: jnp.moveaxis(v.reshape((B, P1, T) + v.shape[2:]), 1, 2)[:, burn_in:]
@@ -201,7 +209,24 @@ class TrainContext:
 
     def __init__(self, module, args: Dict[str, Any], mesh):
         self.module = module
-        self.args = args
+        # '_mesh' rides in the (untraced) args dict so forward_prediction
+        # can hand the mesh to sequence-parallel attention paths
+        self.args = dict(args, _mesh=mesh)
+        if args.get("seq_attention") == "ring":
+            sp = mesh.shape.get("sp", 1)
+            if sp < 2:
+                raise ValueError(
+                    "seq_attention='ring' needs a mesh with an 'sp' axis of "
+                    f"size >= 2 (got {dict(mesh.shape)}); set train_args.mesh "
+                    "accordingly, e.g. {'dp': 2, 'sp': 4}"
+                )
+            T = args["burn_in_steps"] + args["forward_steps"]
+            if T % sp:
+                raise ValueError(
+                    f"seq_attention='ring': window length {T} (burn_in_steps "
+                    f"+ forward_steps) must be divisible by the 'sp' axis "
+                    f"size {sp}"
+                )
         self.mesh = mesh
         self.tx = make_optimizer()
         self._replicated = replicated_sharding(mesh)

@@ -204,3 +204,99 @@ def test_transformer_train_step(env_name):
     state = ctx.init_state(variables["params"])
     state, metrics = ctx.train_step(state, ctx.put_batch(batch), 1e-4)
     assert np.isfinite(float(jax.device_get(metrics["total"])))
+
+
+def test_transformer_train_step_ring_sp():
+    """seq_attention='ring': the FULL train step on a dp x sp mesh with the
+    transformer window sharded across the 'sp' axis — metrics must match
+    the einsum path (same batch, same params)."""
+    from handyrl_tpu.models import RandomModel
+    from handyrl_tpu.parallel import TrainContext, make_mesh
+    from handyrl_tpu.runtime import EpisodeStore, Generator, make_batch
+
+    cfg = normalize_args(
+        {
+            "env_args": {"env": "TicTacToe", "net": "transformer"},
+            "train_args": {
+                "batch_size": 8,
+                "forward_steps": 8,  # T = 8, divisible by sp = 4
+                "burn_in_steps": 0,
+                "compress_steps": 4,
+                "observation": True,
+                "seq_forward": True,
+                "mesh": {"dp": 2, "sp": 4},
+            },
+        }
+    )
+    args = dict(cfg["train_args"])
+    args["env"] = cfg["env_args"]
+
+    env = make_env(args["env"])
+    module = env.net()
+    variables = init_variables(module, env)
+    model = InferenceModel(module, variables)
+    env.reset()
+    random_model = RandomModel.from_model(model, env.observation(env.players()[0]))
+
+    store = EpisodeStore(64)
+    gen = Generator(env, args)
+    gen_args = {"player": env.players(), "model_id": {p: 0 for p in env.players()}}
+    while len(store) < 6:
+        ep = gen.generate({p: random_model for p in env.players()}, gen_args)
+        if ep is not None:
+            store.extend([ep])
+    windows = []
+    while len(windows) < args["batch_size"]:
+        w = store.sample_window(args["forward_steps"], args["burn_in_steps"], args["compress_steps"])
+        if w is not None:
+            windows.append(w)
+    batch = make_batch(windows, args)
+
+    mesh = make_mesh(args["mesh"])
+    results = {}
+    for mode in ("einsum", "ring"):
+        ctx = TrainContext(module, {**args, "seq_attention": mode}, mesh)
+        state = ctx.init_state(variables["params"])
+        state, metrics = ctx.train_step(state, ctx.put_batch(batch), 1e-4)
+        results[mode] = jax.device_get(metrics)
+    for k in ("total", "p", "v", "dcnt"):
+        np.testing.assert_allclose(
+            results["ring"][k], results["einsum"][k], rtol=2e-4, atol=2e-5
+        )
+
+
+def test_ring_mode_requires_sp_axis():
+    """seq_attention='ring' without an 'sp' mesh axis fails at
+    TrainContext construction, not deep inside the first traced step."""
+    from handyrl_tpu.parallel import TrainContext, make_mesh
+
+    cfg = normalize_args(
+        {
+            "env_args": {"env": "TicTacToe", "net": "transformer"},
+            "train_args": {"seq_attention": "ring", "batch_size": 8},
+        }
+    )
+    args = dict(cfg["train_args"])
+    args["env"] = cfg["env_args"]
+    env = make_env(args["env"])
+    with pytest.raises(ValueError, match="sp"):
+        TrainContext(env.net(), args, make_mesh({"dp": -1}))
+
+
+def test_ring_mode_requires_divisible_window():
+    from handyrl_tpu.parallel import TrainContext, make_mesh
+
+    cfg = normalize_args(
+        {
+            "env_args": {"env": "TicTacToe", "net": "transformer"},
+            "train_args": {
+                "seq_attention": "ring", "batch_size": 8,
+                "forward_steps": 10, "mesh": {"dp": 2, "sp": 4},
+            },
+        }
+    )
+    args = dict(cfg["train_args"])
+    args["env"] = cfg["env_args"]
+    env = make_env(args["env"])
+    with pytest.raises(ValueError, match="divisible"):
+        TrainContext(env.net(), args, make_mesh(args["mesh"]))
