@@ -175,10 +175,12 @@ def _timed_loop(step, duration: float) -> float:
 
 
 def _train_bench(env_name: str, overrides, duration: float, n_devices: int,
-                 fill_episodes: int = 48, fused: bool = False):
+                 fill_episodes: int = 48, fused: bool = False, reuse=None):
     """Timed jitted-train-step loop on pre-staged device batches.
 
-    Returns updates/s, trained env-steps/s, flops/step (XLA cost analysis)."""
+    Returns updates/s, trained env-steps/s, flops/step (XLA cost analysis).
+    ``reuse`` recycles a prior result's (module, model, store) so config
+    variants (e.g. bf16) skip episode generation."""
     import jax
 
     from handyrl_tpu.parallel import TrainContext, make_mesh
@@ -187,9 +189,13 @@ def _train_bench(env_name: str, overrides, duration: float, n_devices: int,
     if args["batch_size"] % n_devices:
         args["batch_size"] = max(n_devices, args["batch_size"] // n_devices * n_devices)
 
-    _note(f"{env_name}: generating episodes for the replay store")
-    _, module, model, store = _fill_store(args, 12 if QUICK else fill_episodes)
-    _note(f"{env_name}: store filled; compiling + timing the train step")
+    if reuse is not None:
+        module, model, store = reuse["module"], reuse["model"], reuse["store"]
+        _note(f"{env_name}: reusing filled store; compiling + timing the train step")
+    else:
+        _note(f"{env_name}: generating episodes for the replay store")
+        _, module, model, store = _fill_store(args, 12 if QUICK else fill_episodes)
+        _note(f"{env_name}: store filled; compiling + timing the train step")
 
     mesh = make_mesh(args["mesh"])
     ctx = TrainContext(module, args, mesh)
@@ -465,7 +471,22 @@ def main() -> None:
         result["extra"]["geese_pipeline_updates_per_sec"] = round(pipe["updates_per_sec"], 2)
         result["extra"]["geese_input_wait_frac"] = round(pipe["input_wait_frac"], 4)
     except Exception:
+        gt = None
         result["error"] = (result["error"] or "") + " geese-train: " + traceback.format_exc(limit=3)
+
+    # 3b. bf16 mixed precision (MXU-rate forward/backward, fp32 master
+    # weights) on the same store — the compute_dtype knob's headroom
+    try:
+        if gt is not None:
+            gt16 = _train_bench(
+                "HungryGeese", {**geese_over, "compute_dtype": "bfloat16"},
+                T_TRAIN, len(devices), reuse=gt,
+            )
+            result["extra"]["geese_bf16_updates_per_sec"] = round(
+                gt16["updates_per_sec"], 2
+            )
+    except Exception:
+        result["error"] = (result["error"] or "") + " geese-bf16: " + traceback.format_exc(limit=3)
 
     # 4. recurrent path: Geister DRC ConvLSTM with burn-in + UPGO — the
     # long-horizon imperfect-info config (BASELINE.json configs[3]); the
