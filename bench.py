@@ -27,7 +27,7 @@ import time
 import traceback
 from typing import Optional
 
-import numpy as np
+
 
 REFERENCE_TRAINED_STEPS_PER_SEC = 39707.0  # measured, BASELINE.md (torch CPU)
 REFERENCE_GEN_STEPS_PER_SEC = 1557.0       # measured, BASELINE.md (torch CPU)

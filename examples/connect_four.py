@@ -17,7 +17,7 @@ Run a random self-play smoke loop (like the built-in envs):
 from __future__ import annotations
 
 import random
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 

@@ -27,8 +27,8 @@ Forward-prediction semantics parity (train.py:128-187):
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Dict, Tuple
+
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp

@@ -14,10 +14,10 @@ Layout mirrors the reference naming so tooling ports over:
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
-import numpy as np
+
 from flax import serialization
 
 

@@ -21,9 +21,9 @@ import random
 import threading
 from typing import Any, Dict, List, Optional
 
-import numpy as np
 
-from ..agents import Agent, RandomAgent, RuleBasedAgent, SoftAgent
+
+from ..agents import Agent, RandomAgent, RuleBasedAgent
 from ..envs import make_env
 from ..models import InferenceModel
 from .checkpoint import load_params

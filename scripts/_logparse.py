@@ -10,7 +10,7 @@ loss_plot.py:33-42, stats_plot.py:36-42), so both inputs work here.
 from __future__ import annotations
 
 import json
-import os
+
 import re
 from typing import Any, Dict, List
 

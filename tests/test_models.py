@@ -1,7 +1,7 @@
 """Model family tests: shapes, recurrence, determinism, batching."""
 
 import jax
-import jax.numpy as jnp
+
 import numpy as np
 import pytest
 
