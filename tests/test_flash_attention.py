@@ -107,3 +107,17 @@ def test_masked_flash_gradients():
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_masked_flash_bf16():
+    """compute_dtype=bfloat16 sends bf16 q/k/v through the masked kernel;
+    scores accumulate fp32 either way, so outputs track the fp32 einsum
+    reference within bf16 rounding."""
+    q, k, v, key_mask, slopes = _masked_case(11, 2, 128, 2, 16, 0.8)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = masked_flash_attention(qb, kb, vb, key_mask, slopes, window=8)
+    ref = masked_attention_reference(q, k, v, key_mask, slopes, window=8)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=3e-2, atol=3e-2
+    )
