@@ -18,7 +18,7 @@ sys.path.insert(0, "/root/repo")
 sys.path.insert(0, "/root/reference")
 
 
-def crosscheck(name, ref_module, ours_module, num_games, turn_based):
+def crosscheck(name, ref_module, ours_module, num_games, turn_based, compare_obs=True):
     ref = ref_module.Environment()
     ours = ours_module.Environment()
     rng = random.Random(123)
@@ -35,13 +35,14 @@ def crosscheck(name, ref_module, ours_module, num_games, turn_based):
                 la_ours = sorted(ours.legal_actions(p))
                 assert la_ref == la_ours, (name, g, steps, p, la_ref, la_ours)
                 actions[p] = rng.choice(la_ref)
-                o_ref = ref.observation(p)
-                o_ours = ours.observation(p)
-                if isinstance(o_ref, dict):
-                    for k in o_ref:
-                        np.testing.assert_allclose(o_ref[k], o_ours[k], err_msg=f"{name} obs[{k}] step {steps}")
-                else:
-                    np.testing.assert_allclose(o_ref, o_ours, err_msg=f"{name} obs step {steps}")
+                if compare_obs:
+                    o_ref = ref.observation(p)
+                    o_ours = ours.observation(p)
+                    if isinstance(o_ref, dict):
+                        for k in o_ref:
+                            np.testing.assert_allclose(o_ref[k], o_ours[k], err_msg=f"{name} obs[{k}] step {steps}")
+                    else:
+                        np.testing.assert_allclose(o_ref, o_ours, err_msg=f"{name} obs step {steps}")
                 # string codec parity
                 a = actions[p]
                 assert ref.action2str(a, p) == ours.action2str(a, p)
@@ -50,7 +51,12 @@ def crosscheck(name, ref_module, ours_module, num_games, turn_based):
                 ref.play(actions[p], p)
                 ours.play(actions[p], p)
             else:
+                # simultaneous envs may draw from the global `random` inside
+                # step() (e.g. ParallelTicTacToe picks whose action lands);
+                # replaying the same RNG state into both keeps them lock-step
+                state = random.getstate()
                 ref.step(dict(actions))
+                random.setstate(state)
                 ours.step(dict(actions))
             steps += 1
         assert ours.terminal()
@@ -67,8 +73,20 @@ def main():
     import handyrl.envs.geister as ref_g
     import handyrl_tpu.envs.geister as our_g
     crosscheck("Geister", ref_g, our_g, num_games, turn_based=True)
-    # ParallelTicTacToe steps randomly inside step(); HungryGeese's reference
-    # needs kaggle_environments — both excluded from lock-step comparison.
+
+    import handyrl.envs.parallel_tictactoe as ref_pttt
+    import handyrl_tpu.envs.parallel_tictactoe as our_pttt
+    random.seed(7)  # both sides draw the chooser from the global stream
+    # dynamics only: our observation intentionally fixes the reference's
+    # accidental everyone-gets-the-opponent-view (its turn_view check
+    # compares against turn()'s sentinel return, parallel_tictactoe.py:54)
+    # — documented in handyrl_tpu/envs/parallel_tictactoe.py
+    crosscheck(
+        "ParallelTicTacToe (dynamics)", ref_pttt, our_pttt, num_games,
+        turn_based=False, compare_obs=False,
+    )
+    # HungryGeese's reference needs kaggle_environments (not installable
+    # here) — rule-by-rule diff lives in docs/hungry_geese_parity.md.
 
 
 if __name__ == "__main__":
