@@ -355,6 +355,39 @@ def _pipeline_bench(train_res, duration: float):
     }
 
 
+def _device_selfplay_bench(duration: float):
+    """Fully on-device self-play (runtime/device_rollout.py): env stepping
+    + inference + sampling in ONE jit call over 512 parallel games — the
+    actor plane with zero host round-trips."""
+    import jax
+
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.envs.vector_tictactoe import VectorTicTacToe
+    from handyrl_tpu.models import init_variables
+    from handyrl_tpu.runtime.device_rollout import build_selfplay_fn
+
+    env = make_env({"env": "TicTacToe"})
+    module = env.net()
+    params = init_variables(module, env)["params"]
+    n_games = 512
+    fn = build_selfplay_fn(VectorTicTacToe, module, n_games)
+
+    holder = {"key": jax.random.PRNGKey(0)}
+
+    def call():
+        holder["key"], sub = jax.random.split(holder["key"])
+        cols = fn(params, sub)
+        holder["last"] = cols
+        return cols["alive"]
+
+    calls_per_sec = _timed_loop(call, duration)
+    alive_per_call = float(jax.device_get(holder["last"]["alive"]).sum())
+    return {
+        "env_steps_per_sec": calls_per_sec * alive_per_call,
+        "episodes_per_sec": calls_per_sec * n_games,
+    }
+
+
 def _flash_attention_bench(duration: float = 3.0):
     """Masked Pallas flash kernel vs exact einsum on the transformer
     seq-mode semantics (fwd+bwd), at a long-window shape where the O(T^2)
@@ -437,6 +470,18 @@ def main() -> None:
             result["error"] = (result["error"] or "") + " ttt-fused: " + ttt["fused_error"]
     except Exception:
         result["error"] = (result["error"] or "") + " tictactoe: " + traceback.format_exc(limit=3)
+
+    # 1b. on-device self-play: the zero-host-round-trip actor plane
+    try:
+        dsp = _device_selfplay_bench(T_GEN / 2)
+        result["extra"]["device_selfplay_env_steps_per_sec"] = round(
+            dsp["env_steps_per_sec"], 1
+        )
+        result["extra"]["device_selfplay_vs_reference_gen"] = round(
+            dsp["env_steps_per_sec"] / REFERENCE_GEN_STEPS_PER_SEC, 2
+        )
+    except Exception:
+        result["error"] = (result["error"] or "") + " device-selfplay: " + traceback.format_exc(limit=3)
 
     geese_over = {"turn_based_training": False, "observation": False}
 

@@ -59,6 +59,10 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     # per-call dispatch for small models); 1 = one jit call per update.
     # Semantics are identical: lr is already held constant within an epoch.
     "fused_steps": 1,
+    # N > 0: generate self-play episodes fully ON DEVICE, N parallel games
+    # per jit call (envs exposing a vector twin, e.g. TicTacToe). Workers
+    # then skew toward evaluation; 0 = host actors only.
+    "device_rollout_games": 0,
     "metrics_path": "metrics.jsonl",
     "model_dir": "models",
     "battle_port": 9876,
@@ -106,6 +110,14 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError("train_args.burn_in_steps must be >= 0")
     if train["fused_steps"] < 1:
         raise ValueError("train_args.fused_steps must be >= 1")
+    if train["device_rollout_games"] < 0:
+        raise ValueError("train_args.device_rollout_games must be >= 0")
+    if train["device_rollout_games"] > 0 and train["observation"]:
+        raise ValueError(
+            "device_rollout_games does not support observation: true — "
+            "device episodes record the turn player only (no observer "
+            "views); use host actors for observer-trained recurrent models"
+        )
     if not 0.0 <= train["eval_rate"] <= 1.0:
         raise ValueError("train_args.eval_rate must be in [0, 1]")
     if train["seq_attention"] not in ("auto", "flash", "einsum", "ring"):

@@ -104,6 +104,14 @@ class Environment(BaseEnvironment):
     def players(self):
         return [0, 1]
 
+    @staticmethod
+    def vector_env():
+        """Device-resident twin (pure jnp transitions) for fully on-device
+        self-play (runtime/device_rollout.py)."""
+        from .vector_tictactoe import VectorTicTacToe
+
+        return VectorTicTacToe
+
     def observation(self, player=None):
         """3 planes (C, 3, 3): [is-my-turn-view, my stones, opponent stones]."""
         my_view = player is None or player == self.turn()

@@ -1,0 +1,110 @@
+"""Vectorized TicTacToe as pure jnp state transitions (device-resident).
+
+The host env (envs/tictactoe.py) is the framework's canonical rules
+implementation; this module expresses the SAME rules as batched,
+branch-free array ops so whole populations of games can live and step on
+the accelerator — the substrate for fully on-device self-play
+(runtime/device_rollout.py), an actor-plane design point the reference's
+process-per-actor architecture (worker.py:110-189) cannot reach.
+
+Semantics parity is enforced by tests/test_device_rollout.py: every
+device-generated game replays legally through the host env with the
+identical outcome.
+
+State (per game, batch-leading):
+    cells  (B, 9) int8   0 empty / +1 first player / -1 second player
+    winner (B,)   int8   0 none / +-1
+All transitions are total functions — stepping a finished game is allowed
+and ignored by callers via masks (XLA-static control flow).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tictactoe import WIN_LINES
+
+NUM_ACTIONS = 9
+MAX_STEPS = 9
+NUM_PLAYERS = 2
+
+
+class VectorTicTacToe:
+    """Stateless namespace of batched transition functions.
+
+    Turn order is strict alternation (first player moves at even steps),
+    so ``to_move`` is derived from the step index, not carried.
+    """
+
+    num_actions = NUM_ACTIONS
+    max_steps = MAX_STEPS
+    num_players = NUM_PLAYERS
+
+    @staticmethod
+    def init(n_games: int):
+        return {
+            "cells": jnp.zeros((n_games, 9), jnp.int8),
+            "winner": jnp.zeros((n_games,), jnp.int8),
+        }
+
+    @staticmethod
+    def color(step: int) -> int:
+        """Stone color moving at ``step`` (host TicTacToe: BLACK first)."""
+        return 1 if step % 2 == 0 else -1
+
+    @staticmethod
+    def turn_player(step: int) -> int:
+        return step % 2
+
+    @staticmethod
+    def observation(state, step: int):
+        """(B, 3, 3, 3) planes for the turn player — identical to the host
+        env's turn-player view (tictactoe.py:107-118): [my-view ones,
+        my stones, opponent stones]."""
+        me = VectorTicTacToe.color(step)
+        grid = state["cells"].reshape(-1, 3, 3)
+        B = grid.shape[0]
+        return jnp.stack(
+            [
+                jnp.ones((B, 3, 3), jnp.float32),
+                (grid == me).astype(jnp.float32),
+                (grid == -me).astype(jnp.float32),
+            ],
+            axis=1,
+        )
+
+    @staticmethod
+    def legal_mask(state):
+        """(B, 9) bool — empty cells."""
+        return state["cells"] == 0
+
+    @staticmethod
+    def terminal(state, step: int):
+        """(B,) bool — games finished BEFORE step ``step`` plays."""
+        return (state["winner"] != 0) | (step >= MAX_STEPS)
+
+    @staticmethod
+    def apply(state, actions, step: int):
+        """Play ``actions`` (B,) for the step's turn player in every
+        non-finished game; finished games pass through unchanged."""
+        me = VectorTicTacToe.color(step)
+        live = ~VectorTicTacToe.terminal(state, step)
+        onehot = jax.nn.one_hot(actions, 9, dtype=jnp.int8)
+        cells = jnp.where(
+            (onehot * live[:, None].astype(jnp.int8)) > 0,
+            jnp.int8(me),
+            state["cells"],
+        )
+        # win detection over the 8 line triples
+        lines = cells[:, jnp.asarray(np.asarray(WIN_LINES))]     # (B, 8, 3)
+        won = (lines.sum(axis=-1) == 3 * me).any(axis=-1) & live
+        winner = jnp.where(won, jnp.int8(me), state["winner"])
+        return {"cells": cells, "winner": winner}
+
+    @staticmethod
+    def outcome(state):
+        """(B, 2) float32 — per-player score ordered like host players()."""
+        w = state["winner"].astype(jnp.float32)
+        return jnp.stack([w, -w], axis=1)

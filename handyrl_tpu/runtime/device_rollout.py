@@ -1,0 +1,159 @@
+"""Fully on-device self-play: env stepping + inference + sampling in ONE jit.
+
+The thread-actor plane (runtime/worker.py + inference_engine.py) keeps the
+reference's architecture — host envs, device model — and pays one host
+round-trip per step wave. For envs that also exist as pure jnp transition
+functions (envs/vector_tictactoe.py), this module removes the host from
+the loop entirely: a ``lax.scan`` steps B games for max_steps, sampling
+actions on device via Gumbel-max over legal-masked logits, and the ONLY
+host work left is converting finished games into the standard columnar
+episode format for the replay store. This is the actor-plane design point
+the reference's process tree (worker.py:110-189) cannot express — per-step
+throughput scales with the device batch, not with host round-trips.
+
+Behavior parity with the host Generator (runtime/generation.py):
+temperature-1 softmax sampling over legal-masked logits, recorded
+behavior prob / action mask / critic value per turn player, discounted
+returns (zero for reward-free games), identical columnar block schema —
+pinned by tests/test_device_rollout.py, which replays every device game
+through the host env.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .replay import compress_block
+
+ILLEGAL = 1e32
+
+
+def build_selfplay_fn(venv, module, n_games: int):
+    """Compile-once device self-play for a VectorTicTacToe-style env.
+
+    Returns ``fn(params, rng_key) -> columns`` (jitted), where columns are
+    time-major device arrays over the full max_steps horizon:
+        obs    (T, B, ...)  turn player's observation
+        prob   (T, B)       behavior probability of the selected action
+        action (T, B) int32
+        amask  (T, B, A)    0 legal / 1e32 illegal at selection time
+        value  (T, B)       critic output at acting time
+        alive  (T, B)       1.0 while the game was still running
+        outcome (B, P)      final per-player scores
+    """
+
+    def fn(params, key):
+        keys = jax.random.split(key, venv.max_steps)
+
+        # strict alternation lets the step index be a Python int: unroll
+        # over max_steps (9 for TicTacToe) so observation/turn math is
+        # static per step while the games stay batched on device
+        cols = {"obs": [], "prob": [], "action": [], "amask": [], "value": [], "alive": []}
+        state = venv.init(n_games)
+        for t in range(venv.max_steps):
+            alive = ~venv.terminal(state, t)
+            obs = venv.observation(state, t)
+            out = module.apply({"params": params}, obs, None)
+            logits = out["policy"].astype(jnp.float32)
+            amask = jnp.where(venv.legal_mask(state), 0.0, ILLEGAL)
+            masked = logits - amask
+            # Gumbel-max == sampling from softmax(masked) (generation.py
+            # samples softmax at temperature 1)
+            g = jax.random.gumbel(keys[t], masked.shape)
+            action = jnp.argmax(masked + g, axis=-1)
+            probs = jax.nn.softmax(masked, axis=-1)
+            prob = jnp.take_along_axis(probs, action[:, None], axis=-1)[:, 0]
+
+            cols["obs"].append(obs)
+            cols["prob"].append(prob)
+            cols["action"].append(action.astype(jnp.int32))
+            cols["amask"].append(amask)
+            cols["value"].append(out["value"][:, 0] if out.get("value") is not None else jnp.zeros_like(prob))
+            cols["alive"].append(alive.astype(jnp.float32))
+            state = venv.apply(state, action, t)
+
+        stacked = {k: jnp.stack(v) for k, v in cols.items()}
+        stacked["outcome"] = venv.outcome(state)
+        return stacked
+
+    return jax.jit(fn)
+
+
+def columns_to_episodes(host_cols: Dict[str, Any], venv, args: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Device rollout columns -> standard columnar episodes (the schema of
+    Generator._finalize, runtime/generation.py) ready for EpisodeStore."""
+    P = venv.num_players
+    A = venv.num_actions
+    alive = np.asarray(host_cols["alive"])               # (T, B)
+    lengths = alive.sum(axis=0).astype(np.int32)         # (B,)
+    outcome = np.asarray(host_cols["outcome"])           # (B, P)
+    obs = np.asarray(host_cols["obs"])                   # (T, B, ...)
+    prob = np.asarray(host_cols["prob"])
+    action = np.asarray(host_cols["action"])
+    amask = np.asarray(host_cols["amask"])
+    value = np.asarray(host_cols["value"])
+
+    block_len = args["compress_steps"]
+    players = list(range(P))
+    episodes = []
+    for b in range(obs.shape[1]):
+        T = int(lengths[b])
+        if T == 0:
+            continue
+        blocks = []
+        for lo in range(0, T, block_len):
+            hi = min(lo + block_len, T)
+            t = hi - lo
+            ts = np.arange(lo, hi)
+            tp = ts % P                                   # turn player per step
+            cols = {
+                "prob": np.ones((t, P), np.float32),
+                "action": np.zeros((t, P), np.int32),
+                "amask": np.full((t, P, A), ILLEGAL, np.float32),
+                "value": np.zeros((t, P), np.float32),
+                "reward": np.zeros((t, P), np.float32),
+                "ret": np.zeros((t, P), np.float32),
+                "tmask": np.zeros((t, P), np.float32),
+                "omask": np.zeros((t, P), np.float32),
+                "turn": tp.astype(np.int32),
+            }
+            rows = np.arange(t)
+            cols["prob"][rows, tp] = prob[ts, b]
+            cols["action"][rows, tp] = action[ts, b]
+            cols["amask"][rows, tp] = amask[ts, b]
+            cols["value"][rows, tp] = value[ts, b]
+            cols["tmask"][rows, tp] = 1.0
+            cols["omask"][rows, tp] = 1.0
+            obs_block = np.zeros((t, P) + obs.shape[2:], np.float32)
+            obs_block[rows, tp] = obs[ts, b]
+            cols["obs"] = obs_block
+            blocks.append(compress_block(cols))
+        episodes.append(
+            {
+                "args": {"player": players, "model_id": {p: -1 for p in players}},
+                "steps": T,
+                "players": players,
+                "outcome": {p: float(outcome[b, p]) for p in players},
+                "blocks": blocks,
+            }
+        )
+    return episodes
+
+
+class DeviceRollout:
+    """Compile-once wrapper: generate whole batches of finished episodes
+    with a single device call each."""
+
+    def __init__(self, venv, module, args: Dict[str, Any], n_games: int = 256):
+        self.venv = venv
+        self.args = args
+        self.n_games = n_games
+        self._fn = build_selfplay_fn(venv, module, n_games)
+
+    def generate(self, params, key) -> List[Dict[str, Any]]:
+        cols = self._fn(params, key)
+        return columns_to_episodes(jax.device_get(cols), self.venv, self.args)

@@ -105,13 +105,31 @@ class Learner:
         self._epoch_episodes0 = 0
         self._trainer_thread: Optional[threading.Thread] = None
 
+        # fully on-device self-play (runtime/device_rollout.py): env
+        # stepping + inference + sampling in one jit call per batch of
+        # games; workers then mostly evaluate
+        self._device_games = int(self.args.get("device_rollout_games", 0))
+        self._next_update_episodes = (
+            self.args["minimum_episodes"] + self.args["update_episodes"]
+        )
+        if self._device_games > 0:
+            vector_env = getattr(self.env, "vector_env", None)
+            if vector_env is None:
+                raise ValueError(
+                    f"device_rollout_games set but env "
+                    f"{args['env_args'].get('env')} exposes no vector_env()"
+                )
+            self._venv = vector_env()
+
     # -- request plumbing ---------------------------------------------------
 
-    def handle(self, req: str, data: Any) -> Any:
-        """Thread-safe entry point for workers; blocks until served."""
+    def handle(self, req: str, data: Any, timeout: Optional[float] = None) -> Any:
+        """Thread-safe entry point for workers; blocks until served (or
+        until ``timeout`` — used by the device-rollout thread, whose
+        submission can race server shutdown)."""
         fut: Future = Future()
         self._requests.put((req, data, fut))
-        return fut.result()
+        return fut.result(timeout=timeout)
 
     # -- bookkeeping (train.py:457-500) -------------------------------------
 
@@ -275,6 +293,12 @@ class Learner:
             elif req == "episode":
                 self.feed_episodes([data] if not isinstance(data, list) else data)
                 fut.set_result(None)
+            elif req == "device_episodes":
+                # on-device generation bypasses role assignment; count the
+                # episodes so the eval_rate balance still sees them
+                self.feed_episodes(data)
+                self.num_episodes += len(data)
+                fut.set_result(None)
             elif req == "result":
                 self.feed_results([data] if not isinstance(data, list) else data)
                 fut.set_result(None)
@@ -286,20 +310,60 @@ class Learner:
             if self.num_returned_episodes >= next_update_episodes:
                 prev_update_episodes = next_update_episodes
                 next_update_episodes = prev_update_episodes + self.args["update_episodes"]
+                self._next_update_episodes = next_update_episodes
                 self.update()
                 if self.args["epochs"] >= 0 and self.model_epoch >= self.args["epochs"]:
                     self.shutdown_flag = True
         self.trainer.stop()
         self.model_server.engine.stop()
+        # resolve any futures enqueued after the loop's final iteration
+        # (e.g. the device-rollout thread racing shutdown) — a blocked
+        # handle() would otherwise leak a permanently waiting thread
+        while True:
+            try:
+                _, _, fut = self._requests.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.done():
+                fut.set_result(None)
         if self._trainer_thread is not None:
             self._trainer_thread.join(timeout=30)
         print("finished server")
+
+    def _device_rollout_loop(self) -> None:
+        """Generate device self-play batches up to each epoch boundary
+        (backpressure: pause once the boundary's episode budget is met, so
+        the chip alternates between rollouts and train steps instead of
+        flooding the store)."""
+        import jax
+
+        from .device_rollout import DeviceRollout
+
+        roll = DeviceRollout(self._venv, self.module, self.args, self._device_games)
+        key = jax.random.PRNGKey(self.args["seed"] + 0x5EED)
+        while not self.shutdown_flag:
+            if self.num_returned_episodes >= self._next_update_episodes:
+                time.sleep(0.02)
+                continue
+            epoch, params = self.model_server.latest_snapshot()
+            key, sub = jax.random.split(key)
+            episodes = roll.generate(params, sub)
+            for ep in episodes:
+                ep["args"]["model_id"] = {p: epoch for p in ep["players"]}
+            if self.shutdown_flag:
+                return
+            try:
+                self.handle("device_episodes", episodes, timeout=30.0)
+            except Exception:  # server exited mid-submit; nothing to feed
+                return
 
     def run(self) -> None:
         self._trainer_thread = threading.Thread(target=self.trainer.run, daemon=True)
         self._trainer_thread.start()
         self.worker.run()
         self._active_workers = len(getattr(self.worker, "threads", [])) or self.args["worker"]["num_parallel"]
+        if self._device_games > 0:
+            threading.Thread(target=self._device_rollout_loop, daemon=True).start()
         self.server()
 
 
