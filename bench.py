@@ -388,6 +388,48 @@ def _device_selfplay_bench(duration: float):
     }
 
 
+def _geese_device_selfplay_bench(duration: float, n_lanes: int = 256, k_steps: int = 32):
+    """Streaming on-device HungryGeese self-play: persistent lanes with
+    auto-reset, env stepping + GeeseNet inference + sampling in one jit
+    per k_steps block (runtime/device_rollout.py:StreamingDeviceRollout).
+    This is the north-star actor plane with zero host round-trips per
+    step; episode assembly (compact-record -> columnar) runs inside the
+    timed window, so the number is end-to-end."""
+    import jax
+
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.envs.vector_hungry_geese import VectorHungryGeese
+    from handyrl_tpu.models import init_variables
+    from handyrl_tpu.runtime.device_rollout import StreamingDeviceRollout
+
+    args = _make_args(
+        "HungryGeese", {"turn_based_training": False, "observation": False}
+    )
+    env = make_env(args["env"])
+    module = env.net()
+    params = init_variables(module, env)["params"]
+    roll = StreamingDeviceRollout(
+        VectorHungryGeese, module, args, n_lanes=n_lanes, k_steps=k_steps
+    )
+    key = jax.random.PRNGKey(0)
+    key, sub = jax.random.split(key)
+    roll.generate(params, sub)  # compile + warm
+    steps0, psteps0 = roll.game_steps, roll.player_steps
+    n_eps = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < duration:
+        key, sub = jax.random.split(key)
+        n_eps += len(roll.generate(params, sub))
+    dt = time.perf_counter() - t0
+    return {
+        "env_steps_per_sec": (roll.game_steps - steps0) / dt,
+        "player_steps_per_sec": (roll.player_steps - psteps0) / dt,
+        "episodes_per_sec": n_eps / dt,
+        "lanes": n_lanes,
+        "k_steps": k_steps,
+    }
+
+
 def _flash_attention_bench(duration: float = 3.0):
     """Masked Pallas flash kernel vs exact einsum on the transformer
     seq-mode semantics (fwd+bwd), at a long-window shape where the O(T^2)
@@ -485,7 +527,25 @@ def main() -> None:
 
     geese_over = {"turn_based_training": False, "observation": False}
 
-    # 2. north-star actor plane: HungryGeese generation through the engine
+    # 1c. north-star actor plane, on-device: streaming HungryGeese self-play
+    try:
+        gd = _geese_device_selfplay_bench(T_GEN / 2)
+        result["extra"]["geese_device_selfplay_env_steps_per_sec"] = round(
+            gd["env_steps_per_sec"], 1
+        )
+        result["extra"]["geese_device_selfplay_player_steps_per_sec"] = round(
+            gd["player_steps_per_sec"], 1
+        )
+        result["extra"]["geese_device_selfplay_episodes_per_sec"] = round(
+            gd["episodes_per_sec"], 2
+        )
+        result["extra"]["geese_device_selfplay_vs_reference_gen"] = round(
+            gd["env_steps_per_sec"] / REFERENCE_GEN_STEPS_PER_SEC, 2
+        )
+    except Exception:
+        result["error"] = (result["error"] or "") + " geese-device-selfplay: " + traceback.format_exc(limit=3)
+
+    # 2. host actor plane: HungryGeese generation through the engine
     # (32 actors x 4 simultaneous players pre-submit -> deep request queue,
     # so each device round-trip serves a full inference batch even when
     # per-call latency is high, e.g. a tunneled chip)

@@ -258,6 +258,14 @@ class Environment(BaseEnvironment):
     def action_size(self):
         return 4
 
+    @staticmethod
+    def vector_env():
+        """Device-resident batched rules (streaming on-device self-play,
+        runtime/device_rollout.py)."""
+        from .vector_hungry_geese import VectorHungryGeese
+
+        return VectorHungryGeese
+
     def default_net(self):
         from ..models import GeeseNet
 

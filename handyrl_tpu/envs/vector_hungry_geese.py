@@ -1,0 +1,314 @@
+"""Vectorized Hungry Geese as pure jnp state transitions (device-resident).
+
+The host env (envs/hungry_geese.py) is the canonical rules implementation;
+this module expresses the SAME rules as batched, branch-free array ops so
+whole populations of 4-goose games live and step on the accelerator — the
+substrate for streaming on-device self-play of the north-star env
+(runtime/device_rollout.py:StreamingDeviceRollout).  The reference reaches
+this game only through host-side kaggle_environments
+(reference hungry_geese.py:67), one process per actor; here one jit call
+steps B games x 4 geese and runs GeeseNet on all of them at once.
+
+Rules parity with the host env is enforced lock-step by
+tests/test_device_rollout.py::TestVectorGeeseParity: every transition
+(movement, reversal/self-collision/starvation deaths, hunger, food growth,
+cross-goose head collisions, rank credit, episode end) is compared against
+the host implementation with the device's food spawns injected into the
+host, for hundreds of games.
+
+State (per lane, batch-leading):
+    cells     (B, P, MAXLEN) int32  circular body buffer; position
+                                    (head_ptr + i) % MAXLEN = i-th cell
+                                    from the head, valid for i < length
+    head_ptr  (B, P) int32
+    length    (B, P) int32          0 for dead geese
+    occ       (B, P, C) int8        per-goose body occupancy (maintained
+                                    incrementally; bodies never self-overlap)
+    active    (B, P) bool
+    last_action (B, P) int32        -1 before the first move (host: {})
+    prev_head (B, P) int32          -1 when absent
+    rank      (B, P) int32          (steps survived + 1) * 100 + length
+    food      (B, C) int8           food occupancy mask
+    step      (B,) int32            host step_count (completed steps)
+    done      (B,) bool             game over; lane awaits reset
+
+All transitions are total functions: stepping a finished lane is a no-op,
+so a lax.scan can run lanes of different phases together (XLA-static
+control flow).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hungry_geese import (
+    COLS,
+    HUNGER_RATE,
+    MAX_STEPS,
+    MIN_FOOD,
+    NUM_AGENTS,
+    NUM_CELLS,
+    RANK_SCALE,
+    ROWS,
+    _MOVES,
+)
+
+MAXLEN = NUM_CELLS  # a goose can at most fill the board
+
+# TRANS[cell, action] -> destination cell on the torus (host _translate)
+_trans = np.zeros((NUM_CELLS, 4), np.int32)
+for _c in range(NUM_CELLS):
+    _r, _cc = divmod(_c, COLS)
+    for _a, (_dr, _dc) in enumerate(_MOVES):
+        _trans[_c, _a] = ((_r + _dr) % ROWS) * COLS + (_cc + _dc) % COLS
+TRANS = jnp.asarray(_trans)
+OPPOSITE = jnp.asarray([1, 0, 3, 2], jnp.int32)
+
+
+def _onehot_cell(cell):
+    """one_hot over board cells; -1 (absent) maps to all zeros."""
+    return jax.nn.one_hot(cell, NUM_CELLS, dtype=jnp.int8)
+
+
+class VectorHungryGeese:
+    """Stateless namespace of batched transition functions.
+
+    ``simultaneous = True``: all active players act every step (the
+    device-rollout driver dispatches on this, in contrast to
+    VectorTicTacToe's strict turn alternation).
+    """
+
+    num_actions = 4
+    num_players = NUM_AGENTS
+    max_steps = MAX_STEPS
+    simultaneous = True
+    board_shape = (ROWS, COLS)
+
+    # -- lane (re)initialization -------------------------------------------
+
+    @staticmethod
+    def init(n_lanes: int, key):
+        """Fresh games: 4 goose spawns + MIN_FOOD food on distinct cells,
+        uniformly (host reset: random.sample of NUM_AGENTS+MIN_FOOD cells).
+        Gumbel top-k over equal logits == uniform ordered sample without
+        replacement."""
+        u = jax.random.uniform(key, (n_lanes, NUM_CELLS))
+        _, picks = jax.lax.top_k(u, NUM_AGENTS + MIN_FOOD)  # (B, 6) distinct
+        spawns = picks[:, :NUM_AGENTS]                      # (B, P)
+        food_cells = picks[:, NUM_AGENTS:]                  # (B, MIN_FOOD)
+
+        B = n_lanes
+        cells = jnp.zeros((B, NUM_AGENTS, MAXLEN), jnp.int32)
+        cells = cells.at[:, :, 0].set(spawns)
+        occ = _onehot_cell(spawns)                          # (B, P, C)
+        food = _onehot_cell(food_cells).sum(axis=1).astype(jnp.int8)
+        return {
+            "cells": cells,
+            "head_ptr": jnp.zeros((B, NUM_AGENTS), jnp.int32),
+            "length": jnp.ones((B, NUM_AGENTS), jnp.int32),
+            "occ": occ,
+            "active": jnp.ones((B, NUM_AGENTS), bool),
+            "last_action": jnp.full((B, NUM_AGENTS), -1, jnp.int32),
+            "prev_head": jnp.full((B, NUM_AGENTS), -1, jnp.int32),
+            "rank": jnp.full((B, NUM_AGENTS), RANK_SCALE + 1, jnp.int32),
+            "food": food,
+            "step": jnp.zeros((B,), jnp.int32),
+            "done": jnp.zeros((B,), bool),
+        }
+
+    @staticmethod
+    def reset_done(state, key):
+        """Re-init every lane whose game has finished (streaming auto-reset:
+        the scan never wastes iterations on dead lanes)."""
+        fresh = VectorHungryGeese.init(state["done"].shape[0], key)
+        done = state["done"]
+
+        def pick(new, old):
+            d = done.reshape((-1,) + (1,) * (old.ndim - 1))
+            return jnp.where(d, new, old)
+
+        return jax.tree.map(pick, fresh, state)
+
+    # -- views --------------------------------------------------------------
+
+    @staticmethod
+    def head_cell(state):
+        """(B, P) current head cell, -1 for empty geese."""
+        head = jnp.take_along_axis(
+            state["cells"], state["head_ptr"][..., None], axis=-1
+        )[..., 0]
+        return jnp.where(state["length"] > 0, head, -1)
+
+    @staticmethod
+    def tail_cell(state):
+        """(B, P) current tail-tip cell, -1 for empty geese."""
+        idx = (state["head_ptr"] + state["length"] - 1) % MAXLEN
+        tail = jnp.take_along_axis(state["cells"], idx[..., None], axis=-1)[..., 0]
+        return jnp.where(state["length"] > 0, tail, -1)
+
+    @staticmethod
+    def observation(state):
+        """(B, P, 17, 7, 11) float32 — the host env's 17 planes for every
+        player: head/tail/body/prev-head per goose with the goose axis
+        rotated so the viewing player is channel 0, plus food
+        (host observation(), envs/hungry_geese.py:242-256)."""
+        heads = _onehot_cell(VectorHungryGeese.head_cell(state)).astype(jnp.float32)
+        tails = _onehot_cell(VectorHungryGeese.tail_cell(state)).astype(jnp.float32)
+        body = state["occ"].astype(jnp.float32)
+        prev = _onehot_cell(state["prev_head"]).astype(jnp.float32)
+        food = state["food"].astype(jnp.float32)[:, None, :]  # (B, 1, C)
+
+        views = []
+        for p in range(NUM_AGENTS):
+            planes = jnp.concatenate(
+                [
+                    jnp.roll(heads, -p, axis=1),
+                    jnp.roll(tails, -p, axis=1),
+                    jnp.roll(body, -p, axis=1),
+                    jnp.roll(prev, -p, axis=1),
+                    food,
+                ],
+                axis=1,
+            )  # (B, 17, C)
+            views.append(planes)
+        obs = jnp.stack(views, axis=1)  # (B, P, 17, C)
+        return obs.reshape(obs.shape[:3] + (ROWS, COLS))
+
+    # -- transition ---------------------------------------------------------
+
+    @staticmethod
+    def step(state, actions, key):
+        """Play ``actions`` (B, P) int32 for every active goose; finished
+        lanes pass through unchanged.  Mirrors host step()
+        (envs/hungry_geese.py:92-142) phase for phase; the one deliberate
+        difference — parallel instead of sequential food consumption — is
+        unobservable (two geese reaching one food share a head cell and
+        both die in the collision phase either way)."""
+        tg = state["step"] + 1                                   # (B,)
+        active = state["active"]                                 # (B, P)
+        head0 = VectorHungryGeese.head_cell(state)               # (B, P)
+        new_prev_head = jnp.where(state["length"] > 0, head0, -1)
+
+        # phase 1: reversal deaths (into own neck, host:103-104)
+        reversal = (
+            active
+            & (state["last_action"] >= 0)
+            & (actions == OPPOSITE[jnp.clip(state["last_action"], 0, 3)])
+        )
+        movers = active & ~reversal
+
+        # phase 2: movement + food + self-collision (host:106-113)
+        new_head = TRANS[jnp.clip(head0, 0, NUM_CELLS - 1), jnp.clip(actions, 0, 3)]
+        eat = movers & (jnp.take_along_axis(state["food"], new_head, axis=1) > 0)
+        pop = movers & ~eat
+        tail0 = VectorHungryGeese.tail_cell(state)
+        occ = state["occ"] - _onehot_cell(tail0) * pop[..., None].astype(jnp.int8)
+        length = state["length"] - pop
+
+        self_col = movers & (
+            jnp.take_along_axis(occ, new_head[..., None], axis=-1)[..., 0] > 0
+        )
+        insert = movers & ~self_col
+        head_ptr = jnp.where(insert, (state["head_ptr"] - 1) % MAXLEN, state["head_ptr"])
+        slot = jax.nn.one_hot(head_ptr, MAXLEN, dtype=bool) & insert[..., None]
+        cells = jnp.where(slot, new_head[..., None], state["cells"])
+        occ = occ + _onehot_cell(new_head) * insert[..., None].astype(jnp.int8)
+        length = length + insert
+
+        # phase 3: hunger every HUNGER_RATE-th step, after the move
+        # (host:115-119); starving to zero kills
+        hunger = insert & (tg % HUNGER_RATE == 0)[:, None]
+        tail1_idx = (head_ptr + length - 1) % MAXLEN
+        tail1 = jnp.take_along_axis(cells, tail1_idx[..., None], axis=-1)[..., 0]
+        occ = occ - _onehot_cell(tail1) * hunger[..., None].astype(jnp.int8)
+        length = length - hunger
+        starve = hunger & (length == 0)
+
+        alive = active & ~(reversal | self_col | starve)
+        occ = occ * alive[..., None].astype(jnp.int8)
+        length = length * alive
+
+        # phase 4: cross-goose collisions — any head on a cell covered by
+        # >1 goose cells dies; dead bodies are already off the board
+        # (host:121-128)
+        total_occ = occ.sum(axis=1)                              # (B, C)
+        collide = alive & (
+            jnp.take_along_axis(total_occ, new_head, axis=1) > 1
+        )
+        alive = alive & ~collide
+        occ = occ * alive[..., None].astype(jnp.int8)
+        length = length * alive
+
+        # food eaten this step is gone even if the eater then died (host:108)
+        eaten = (_onehot_cell(new_head) * eat[..., None].astype(jnp.int8)).sum(axis=1)
+        food = (state["food"] & ~(eaten > 0)).astype(jnp.int8)
+
+        # phase 5: rank credit only for survivors of the whole step
+        # (host:130-135)
+        rank = jnp.where(alive, (tg + 1)[:, None] * RANK_SCALE + length, state["rank"])
+
+        # phase 6: food respawn to MIN_FOOD on uniformly-random free cells
+        # (host _spawn_food:148-154); two conditional Gumbel-max draws
+        total_occ = occ.sum(axis=1)
+        free = (total_occ == 0) & (food == 0)                    # (B, C)
+        n_food = food.sum(axis=1, dtype=jnp.int32)               # (B,)
+        k1, k2 = jax.random.split(key)
+        g1 = jnp.where(free, jax.random.gumbel(k1, free.shape), -jnp.inf)
+        cand1 = jnp.argmax(g1, axis=1)
+        do1 = (n_food < MIN_FOOD) & free.any(axis=1)
+        food = food | (_onehot_cell(cand1) * do1[:, None].astype(jnp.int8))
+        free = free & ~((_onehot_cell(cand1) > 0) & do1[:, None])
+        g2 = jnp.where(free, jax.random.gumbel(k2, free.shape), -jnp.inf)
+        cand2 = jnp.argmax(g2, axis=1)
+        do2 = (n_food + do1 < MIN_FOOD) & free.any(axis=1)
+        food = food | (_onehot_cell(cand2) * do2[:, None].astype(jnp.int8))
+
+        # phase 7: episode end — at most one survivor or step cap
+        # (host:139-140 deactivates everyone)
+        ended = (alive.sum(axis=1, dtype=jnp.int32) <= 1) | (tg >= MAX_STEPS)
+        active_next = alive & ~ended[:, None]
+
+        return {
+            "cells": cells,
+            "head_ptr": head_ptr,
+            "length": length,
+            "occ": occ,
+            "active": active_next,
+            # host keeps acted actions for every player, 0 for absent
+            # (host:96,142); only active geese ever consult it again
+            "last_action": jnp.where(active, actions, 0),
+            "prev_head": new_prev_head,
+            "rank": rank,
+            "food": food,
+            "step": tg,
+            "done": state["done"] | ended,
+        }
+
+    # -- host-side helpers (parity tests, episode assembly) -----------------
+
+    @staticmethod
+    def body_list(state, lane: int, player: int):
+        """Ordered body cells head-first, as the host env stores them."""
+        cells = np.asarray(state["cells"])[lane, player]
+        ptr = int(np.asarray(state["head_ptr"])[lane, player])
+        length = int(np.asarray(state["length"])[lane, player])
+        return [int(cells[(ptr + i) % MAXLEN]) for i in range(length)]
+
+    @staticmethod
+    def outcome_from_rank(rank_row) -> dict:
+        """Pairwise rank outcome (+-1/(P-1) per beaten/losing opponent),
+        identical to host outcome() (envs/hungry_geese.py:188-199)."""
+        out = {}
+        for p in range(NUM_AGENTS):
+            score = 0.0
+            for q in range(NUM_AGENTS):
+                if p == q:
+                    continue
+                if rank_row[p] > rank_row[q]:
+                    score += 1 / (NUM_AGENTS - 1)
+                elif rank_row[p] < rank_row[q]:
+                    score -= 1 / (NUM_AGENTS - 1)
+            out[p] = score
+        return out

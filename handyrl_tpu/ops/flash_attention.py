@@ -253,10 +253,10 @@ def _masked_flash_kernel(
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
-        c_q = cq_ref[0].astype(jnp.float32)                  # (blk_q,)
-        c_k = ck_ref[0].astype(jnp.float32)                  # (blk_k,)
-        m_k = mk_ref[0].astype(jnp.float32)
-        slope = slope_ref[0, 0]
+        c_q = cq_ref[0, 0].astype(jnp.float32)               # (blk_q,)
+        c_k = ck_ref[0, 0].astype(jnp.float32)               # (blk_k,)
+        m_k = mk_ref[0, 0].astype(jnp.float32)
+        slope = slope_ref[0, 0, 0]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -338,10 +338,14 @@ def _masked_flash_forward(q, k, v, key_mask, slopes, window, blk_q, blk_k, inter
     Dp = qf.shape[-1]
     n_q, n_k = Tp // blk_q, Tp // blk_k
 
-    # padded key rows: mask 0 (invisible), counts edge-padded (finite ages)
-    mask_p = jnp.pad(key_mask.astype(jnp.float32), ((0, 0), (0, Tp - T)))
-    counts_p = jnp.pad(counts, ((0, 0), (0, Tp - T)), mode="edge")
-    slopes_col = jnp.tile(slopes.astype(jnp.float32)[None, :], (B, 1)).reshape(B * H, 1)
+    # padded key rows: mask 0 (invisible), counts edge-padded (finite ages).
+    # Rows ride as (B, 1, Tp) so their VMEM blocks are (1, 1, blk): the TPU
+    # tiling rule wants the block's last two dims divisible by (8, 128) or
+    # equal to the array dims — (1, blk) against a (B, Tp) array is neither
+    # (round-1 bench failure on the real chip; the interpreter accepted it).
+    mask_p = jnp.pad(key_mask.astype(jnp.float32), ((0, 0), (0, Tp - T)))[:, None, :]
+    counts_p = jnp.pad(counts, ((0, 0), (0, Tp - T)), mode="edge")[:, None, :]
+    slopes_col = jnp.tile(slopes.astype(jnp.float32)[None, :], (B, 1)).reshape(B * H, 1, 1)
 
     kernel = functools.partial(
         _masked_flash_kernel,
@@ -354,10 +358,10 @@ def _masked_flash_forward(q, k, v, key_mask, slopes, window, blk_q, blk_k, inter
             pl.BlockSpec((1, blk_q, Dp), lambda bh, qi, kb: (bh, qi, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, blk_k, Dp), lambda bh, qi, kb: (bh, kb, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, blk_k, Dp), lambda bh, qi, kb: (bh, kb, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk_q), lambda bh, qi, kb: (bh // H, qi), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk_k), lambda bh, qi, kb: (bh // H, kb), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk_k), lambda bh, qi, kb: (bh // H, kb), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda bh, qi, kb: (bh, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, blk_q), lambda bh, qi, kb: (bh // H, 0, qi), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, blk_k), lambda bh, qi, kb: (bh // H, 0, kb), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, blk_k), lambda bh, qi, kb: (bh // H, 0, kb), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 1), lambda bh, qi, kb: (bh, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
             (1, blk_q, Dp), lambda bh, qi, kb: (bh, qi, 0), memory_space=pltpu.VMEM

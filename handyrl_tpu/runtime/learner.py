@@ -337,9 +337,9 @@ class Learner:
         flooding the store)."""
         import jax
 
-        from .device_rollout import DeviceRollout
+        from .device_rollout import make_device_rollout
 
-        roll = DeviceRollout(self._venv, self.module, self.args, self._device_games)
+        roll = make_device_rollout(self._venv, self.module, self.args, self._device_games)
         key = jax.random.PRNGKey(self.args["seed"] + 0x5EED)
         while not self.shutdown_flag:
             if self.num_returned_episodes >= self._next_update_episodes:
@@ -352,10 +352,21 @@ class Learner:
                 ep["args"]["model_id"] = {p: epoch for p in ep["players"]}
             if self.shutdown_flag:
                 return
-            try:
-                self.handle("device_episodes", episodes, timeout=30.0)
-            except Exception:  # server exited mid-submit; nothing to feed
-                return
+            # submit once and wait on the SAME future with a patience loop:
+            # the server loop can be busy for minutes at an epoch boundary
+            # (trainer snapshot + first-epoch jit compile), and re-raising
+            # on a fixed timeout would silently kill on-device generation
+            # for the rest of the run
+            fut: Future = Future()
+            self._requests.put(("device_episodes", episodes, fut))
+            while not fut.done():
+                try:
+                    fut.result(timeout=5.0)
+                except TimeoutError:
+                    if self.shutdown_flag:
+                        return  # server draining/exited; nothing to feed
+                except Exception:
+                    return
 
     def run(self) -> None:
         self._trainer_thread = threading.Thread(target=self.trainer.run, daemon=True)

@@ -9,9 +9,20 @@ Modes:
     --eval-client / -ec      network battle client
 """
 
+import os
 import sys
 
 import yaml
+
+# Platform override BEFORE any backend initializes.  The JAX_PLATFORMS env
+# var alone is not reliable on hosts whose site customization imports jax at
+# interpreter startup and pins a platform via jax.config (config beats env);
+# HANDYRL_PLATFORM re-pins it here, e.g. HANDYRL_PLATFORM=cpu for a virtual
+# CPU mesh run of the full CLI.
+if os.environ.get("HANDYRL_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["HANDYRL_PLATFORM"])
 
 from handyrl_tpu.config import normalize_args
 

@@ -6,6 +6,7 @@ pipeline consumes, and (c) trainable end-to-end.
 """
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from handyrl_tpu.config import normalize_args
@@ -103,6 +104,224 @@ def test_device_episodes_train():
     state, metrics = ctx.train_step(state, ctx.put_batch(batch), 1e-4)
     m = jax.device_get(metrics)
     assert np.isfinite(m["total"]) and m["dcnt"] > 0
+
+
+class TestVectorGeeseParity:
+    """VectorHungryGeese (envs/vector_hungry_geese.py) vs the canonical
+    host rules, lock-step: every phase of the transition — reversal /
+    self-collision / starvation deaths, food growth, hunger, cross-goose
+    collisions, rank credit, episode end — must match the host env for the
+    same actions, with the device's food spawns injected into the host
+    (host food placement is `random.choice`; positions are the only
+    nondeterminism, and uniformity is asserted separately)."""
+
+    def _init_pair(self, n_lanes, seed):
+        from handyrl_tpu.envs.hungry_geese import Environment
+        from handyrl_tpu.envs.vector_hungry_geese import VectorHungryGeese as V
+
+        state = V.init(n_lanes, jax.random.PRNGKey(seed))
+        hosts = []
+        for b in range(n_lanes):
+            e = Environment()
+            e.reset()
+            e.geese = [[V.body_list(state, b, p)[0]] for p in range(4)]
+            e.food = [int(c) for c in np.flatnonzero(np.asarray(state["food"])[b])]
+            hosts.append(e)
+        return V, state, hosts
+
+    def _assert_lane(self, V, state, host, b, ctx):
+        for p in range(4):
+            assert V.body_list(state, b, p) == list(host.geese[p]), (ctx, b, p)
+            assert bool(np.asarray(state["active"])[b, p]) == host.active[p], (ctx, b, p)
+            assert int(np.asarray(state["rank"])[b, p]) == host.rank_rewards[p], (ctx, b, p)
+        assert bool(np.asarray(state["done"])[b]) == host.terminal(), (ctx, b)
+
+    def _run_lockstep(self, n_lanes, n_steps, seed, policy):
+        V, state, hosts = self._init_pair(n_lanes, seed)
+        step = jax.jit(V.step)
+        rng = np.random.default_rng(seed)
+        key = jax.random.PRNGKey(seed + 1)
+        finished = 0
+        max_step_seen = 0
+        for t in range(n_steps):
+            actions = policy(hosts, rng)
+            key, ks = jax.random.split(key)
+            prev_done = np.asarray(state["done"]).copy()
+            prev_food = [set(host.food) for host in hosts]  # common pre-step food
+            state = step(state, jnp.asarray(actions), ks)
+            for b, host in enumerate(hosts):
+                if prev_done[b]:
+                    continue
+                host.step({p: int(actions[b, p]) for p in host.turns()})
+                dev_food = set(
+                    int(c) for c in np.flatnonzero(np.asarray(state["food"])[b])
+                )
+                # Food parity BEFORE injecting the device's spawns: both
+                # sides must keep/remove the same pre-existing food (eating
+                # semantics) and reach the same count (spawn-to-MIN_FOOD
+                # semantics); only spawn POSITIONS may differ (RNG).
+                assert dev_food & prev_food[b] == set(host.food) & prev_food[b], (t, b)
+                assert len(dev_food) == len(host.food), (t, b)
+                host.food = list(dev_food)
+                max_step_seen = max(max_step_seen, host.step_count)
+                if host.terminal():
+                    finished += 1
+                self._assert_lane(V, state, hosts[b], b, t)
+        return finished, max_step_seen
+
+    def test_lockstep_random(self):
+        """Random actions: exercises reversal deaths, head-on collisions,
+        food growth, early episode ends."""
+        finished, _ = self._run_lockstep(
+            48, 40, 0, lambda hosts, rng: rng.integers(0, 4, (len(hosts), 4)).astype(np.int32)
+        )
+        assert finished >= 40  # random geese die fast; most games must finish
+
+    def test_lockstep_greedy_reaches_hunger(self):
+        """Greedy survival policy: games must live past step 40 so the
+        hunger tail-pop (t % 40 == 0) and long-body dynamics are covered."""
+        def policy(hosts, rng):
+            acts = np.zeros((len(hosts), 4), np.int32)
+            for b, host in enumerate(hosts):
+                for p in range(4):
+                    acts[b, p] = (
+                        host.rule_based_action(p) if host.active[p]
+                        else rng.integers(0, 4)
+                    )
+            return acts
+
+        finished, max_step = self._run_lockstep(12, 70, 7, policy)
+        assert max_step > 40, "no game survived past the hunger step"
+
+    def test_food_spawn_uniform_and_valid(self):
+        """Device food spawns land only on free cells and cover the board
+        roughly uniformly (the host uses random.choice over free cells)."""
+        from handyrl_tpu.envs.vector_hungry_geese import VectorHungryGeese as V
+
+        state = V.init(256, jax.random.PRNGKey(3))
+        step = jax.jit(V.step)
+        key = jax.random.PRNGKey(4)
+        rng = np.random.default_rng(5)
+        counts = np.zeros(77, np.int64)
+        for t in range(12):
+            key, ks = jax.random.split(key)
+            prev_food = np.asarray(state["food"]).copy()
+            state = V.reset_done(state, jax.random.fold_in(key, t))
+            state = step(state, jnp.asarray(rng.integers(0, 4, (256, 4)), np.int32), ks)
+            food, occ = np.asarray(state["food"]), np.asarray(state["occ"]).sum(1)
+            assert not np.any((food > 0) & (occ > 0)), "food spawned on a goose"
+            new = (food > 0) & (prev_food == 0)
+            counts += new.sum(0)
+        assert counts.sum() > 500
+        # uniformity: no cell should dominate (loose 5x-of-mean bound)
+        assert counts.max() < 5 * counts.mean() + 10
+
+
+class TestStreamingRollout:
+    """StreamingDeviceRollout: persistent lanes, auto-reset, episode
+    stitching across calls, columnar schema, trainability."""
+
+    def _episodes(self, n_calls=6, n_lanes=32, k_steps=16, seed=0):
+        from handyrl_tpu.envs.vector_hungry_geese import VectorHungryGeese
+        from handyrl_tpu.runtime.device_rollout import StreamingDeviceRollout
+
+        env = make_env({"env": "HungryGeese"})
+        module = env.net()
+        variables = init_variables(module, env)
+        cfg = normalize_args({
+            "env_args": {"env": "HungryGeese"},
+            "train_args": {"batch_size": 8, "forward_steps": 8,
+                           "turn_based_training": False, "observation": False},
+        })
+        args = dict(cfg["train_args"])
+        args["env"] = cfg["env_args"]
+        roll = StreamingDeviceRollout(
+            VectorHungryGeese, module, args, n_lanes=n_lanes, k_steps=k_steps
+        )
+        key = jax.random.PRNGKey(seed)
+        episodes = []
+        for _ in range(n_calls):
+            key, sub = jax.random.split(key)
+            episodes += roll.generate(variables["params"], sub)
+        return env, module, variables, args, roll, episodes
+
+    def test_schema_and_outcomes(self):
+        env, module, variables, args, roll, episodes = self._episodes()
+        assert len(episodes) > 10
+        assert roll.game_steps > 0 and roll.player_steps >= roll.game_steps
+        for ep in episodes:
+            cols = [decompress_block(b) for b in ep["blocks"]]
+            obs = np.concatenate([c["obs"] for c in cols])
+            tmask = np.concatenate([c["tmask"] for c in cols])
+            amask = np.concatenate([c["amask"] for c in cols])
+            assert obs.shape[1:] == (4, 17, 7, 11)
+            assert amask.shape[1:] == (4, 4)  # full action dim (mixes with host episodes)
+            assert sum(c["prob"].shape[0] for c in cols) == ep["steps"]
+            # zero-sum pairwise rank outcome
+            assert abs(sum(ep["outcome"].values())) < 1e-9
+            # all four geese act at step one; actors strictly shrink
+            n_act = tmask.sum(axis=1)
+            assert n_act[0] == 4.0
+            assert (np.diff(n_act) <= 0 + 1e-9).all()
+            # active rows carry an all-legal mask, dead rows the 1e32 fill
+            assert ((amask == 0.0) == (tmask[..., None] > 0)).all()
+
+    def test_observations_match_host_builder(self):
+        """Rebuilt compact-record observations must equal the host env's
+        observation() for the same reconstructed position."""
+        from handyrl_tpu.envs.hungry_geese import Environment
+
+        env, module, variables, args, roll, episodes = self._episodes(n_calls=3)
+        checked = 0
+        for ep in episodes[:8]:
+            cols = [decompress_block(b) for b in ep["blocks"]]
+            obs = np.concatenate([c["obs"] for c in cols])
+            tmask = np.concatenate([c["tmask"] for c in cols])
+            # reconstruct host state at t=0 from the obs planes themselves:
+            # single-cell geese + food — then verify the builder agrees
+            host = Environment()
+            host.reset()
+            heads = [int(np.flatnonzero(obs[0, 0, 8 + ((p - 0) % 4)].reshape(-1))[0])
+                     for p in range(4)]
+            host.geese = [[heads[p]] for p in range(4)]
+            host.food = [int(c) for c in np.flatnonzero(obs[0, 0, 16].reshape(-1))]
+            host.prev_heads = [None] * 4
+            for p in range(4):
+                if tmask[0, p] > 0:
+                    np.testing.assert_array_equal(obs[0, p], host.observation(p))
+                    checked += 1
+        assert checked >= 8
+
+    def test_streaming_episodes_train(self):
+        from handyrl_tpu.parallel import TrainContext, make_mesh
+        from handyrl_tpu.runtime.batch import make_batch
+
+        env, module, variables, args, roll, episodes = self._episodes()
+        store = EpisodeStore(512)
+        store.extend(episodes)
+        windows = []
+        while len(windows) < args["batch_size"]:
+            w = store.sample_window(args["forward_steps"], 0, args["compress_steps"])
+            if w is not None:
+                windows.append(w)
+        batch = make_batch(windows, args)
+        ctx = TrainContext(module, args, make_mesh({"dp": -1}))
+        state = ctx.init_state(variables["params"])
+        state, metrics = ctx.train_step(state, ctx.put_batch(batch), 1e-4)
+        m = jax.device_get(metrics)
+        assert np.isfinite(m["total"]) and m["dcnt"] > 0
+
+    def test_lanes_stitch_across_calls(self):
+        """Episodes longer than k_steps must span device calls.  The
+        freshly-initialized GeeseNet is near-deterministic (large logit
+        scale), so whole populations march in lockstep until the t=40
+        hunger pop starves them — 12 calls x 4 steps crosses that point,
+        and every such episode spans ~10 device calls."""
+        env, module, variables, args, roll, episodes = self._episodes(
+            n_calls=12, n_lanes=16, k_steps=4
+        )
+        assert episodes, "no episode finished in 48 steps"
+        assert max(ep["steps"] for ep in episodes) > 4
 
 
 def test_learner_with_device_rollouts(tmp_path, monkeypatch):
