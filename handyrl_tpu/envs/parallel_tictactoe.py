@@ -56,6 +56,13 @@ class Environment(TicTacToe):
     def turns(self):
         return self.players()
 
+    @staticmethod
+    def vector_env():
+        """Device-resident batched rules (streaming on-device self-play)."""
+        from .vector_parallel_tictactoe import VectorParallelTicTacToe
+
+        return VectorParallelTicTacToe
+
     def observation(self, player=None):
         """Per-player view: [always-acting plane, my stones, theirs].
 

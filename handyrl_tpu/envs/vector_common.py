@@ -1,0 +1,19 @@
+"""Shared helpers for device-resident (vector) envs."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def reset_where_done(fresh, state):
+    """Per-lane select: lanes flagged ``done`` in ``state`` take the
+    corresponding ``fresh`` (re-initialized) leaves, others pass through —
+    the streaming auto-reset primitive (runtime/device_rollout.py)."""
+    done = state["done"]
+
+    def pick(new, old):
+        d = done.reshape((-1,) + (1,) * (old.ndim - 1))
+        return jnp.where(d, new, old)
+
+    return jax.tree.map(pick, fresh, state)

@@ -122,14 +122,10 @@ class VectorHungryGeese:
     def reset_done(state, key):
         """Re-init every lane whose game has finished (streaming auto-reset:
         the scan never wastes iterations on dead lanes)."""
+        from .vector_common import reset_where_done
+
         fresh = VectorHungryGeese.init(state["done"].shape[0], key)
-        done = state["done"]
-
-        def pick(new, old):
-            d = done.reshape((-1,) + (1,) * (old.ndim - 1))
-            return jnp.where(d, new, old)
-
-        return jax.tree.map(pick, fresh, state)
+        return reset_where_done(fresh, state)
 
     # -- views --------------------------------------------------------------
 
@@ -182,10 +178,11 @@ class VectorHungryGeese:
     def step(state, actions, key):
         """Play ``actions`` (B, P) int32 for every active goose; finished
         lanes pass through unchanged.  Mirrors host step()
-        (envs/hungry_geese.py:92-142) phase for phase; the one deliberate
-        difference — parallel instead of sequential food consumption — is
-        unobservable (two geese reaching one food share a head cell and
-        both die in the collision phase either way)."""
+        (envs/hungry_geese.py:92-142) phase for phase, including the
+        SEQUENTIAL food semantics: when several geese reach the same food,
+        only the lowest-indexed one eats (the host's per-goose loop removes
+        the food first) — the losers pop their tails, which a bystander
+        colliding with such a tail cell can observe."""
         tg = state["step"] + 1                                   # (B,)
         active = state["active"]                                 # (B, P)
         head0 = VectorHungryGeese.head_cell(state)               # (B, P)
@@ -202,6 +199,13 @@ class VectorHungryGeese:
         # phase 2: movement + food + self-collision (host:106-113)
         new_head = TRANS[jnp.clip(head0, 0, NUM_CELLS - 1), jnp.clip(actions, 0, 3)]
         eat = movers & (jnp.take_along_axis(state["food"], new_head, axis=1) > 0)
+        # contested food goes to the lowest-indexed goose only (host
+        # processes geese in order and removes eaten food mid-loop): a
+        # goose loses its claim if any lower-indexed mover eats the same
+        # cell this step
+        same_cell = (new_head[:, :, None] == new_head[:, None, :]) & eat[:, :, None] & eat[:, None, :]
+        lower = jnp.tril(jnp.ones((NUM_AGENTS, NUM_AGENTS), bool), k=-1)  # q < p
+        eat = eat & ~(same_cell & lower[None]).any(axis=2)
         pop = movers & ~eat
         tail0 = VectorHungryGeese.tail_cell(state)
         occ = state["occ"] - _onehot_cell(tail0) * pop[..., None].astype(jnp.int8)
@@ -286,7 +290,71 @@ class VectorHungryGeese:
             "done": state["done"] | ended,
         }
 
-    # -- host-side helpers (parity tests, episode assembly) -----------------
+    # -- streaming-rollout hooks (runtime/device_rollout.py) ----------------
+
+    @staticmethod
+    def legal_mask_all(state):
+        """(B, P, A) bool — every direction is always legal (reversal is
+        legal-but-lethal, host legal_actions: envs/hungry_geese.py:201-202)."""
+        B, P = state["active"].shape
+        return jnp.ones((B, P, 4), bool)
+
+    @staticmethod
+    def record(state):
+        """Compact per-step fields from which the host rebuilds the
+        17-plane observations (~40x smaller than the planes themselves)."""
+        return {
+            "occ": state["occ"],
+            "head": VectorHungryGeese.head_cell(state).astype(jnp.int8),
+            "tail": VectorHungryGeese.tail_cell(state).astype(jnp.int8),
+            "prev_head": state["prev_head"].astype(jnp.int8),
+            "food": state["food"],
+        }
+
+    @staticmethod
+    def outcome_scores(state):
+        """(B, P) pairwise rank outcome (+-1/(P-1) per beaten/losing
+        opponent), identical to host outcome() (envs/hungry_geese.py:188-199);
+        final scores where ``done``."""
+        rank = state["rank"]
+        gt = (rank[:, :, None] > rank[:, None, :]).sum(axis=2, dtype=jnp.int32)
+        lt = (rank[:, :, None] < rank[:, None, :]).sum(axis=2, dtype=jnp.int32)
+        return (gt - lt).astype(jnp.float32) / (NUM_AGENTS - 1)
+
+    @staticmethod
+    def episode_obs(compact, active):
+        """Rebuild (T, P, 17, 7, 11) observation planes from the compact
+        record, exactly as the host env builds them
+        (envs/hungry_geese.py:242-256); vectorized numpy scatter."""
+        occ = compact["occ"].astype(np.float32)              # (T, P, C)
+        head = compact["head"].astype(np.int32)
+        tail = compact["tail"].astype(np.int32)
+        prev = compact["prev_head"].astype(np.int32)
+        food = compact["food"].astype(np.float32)            # (T, C)
+
+        cell_ids = np.arange(NUM_CELLS, dtype=np.int32)
+        heads_oh = (head[..., None] == cell_ids).astype(np.float32)
+        tails_oh = (tail[..., None] == cell_ids).astype(np.float32)
+        prev_oh = (prev[..., None] == cell_ids).astype(np.float32)
+        food_pl = food[:, None, :]
+
+        views = []
+        for p in range(NUM_AGENTS):
+            planes = np.concatenate(
+                [
+                    np.roll(heads_oh, -p, axis=1),
+                    np.roll(tails_oh, -p, axis=1),
+                    np.roll(occ, -p, axis=1),
+                    np.roll(prev_oh, -p, axis=1),
+                    food_pl,
+                ],
+                axis=1,
+            )  # (T, 4*P+1, C)
+            views.append(planes * active[:, p, None, None])
+        obs = np.stack(views, axis=1)  # (T, P, planes, C)
+        return obs.reshape(obs.shape[:3] + (ROWS, COLS))
+
+    # -- host-side helpers (parity tests) -----------------------------------
 
     @staticmethod
     def body_list(state, lane: int, player: int):
@@ -295,20 +363,3 @@ class VectorHungryGeese:
         ptr = int(np.asarray(state["head_ptr"])[lane, player])
         length = int(np.asarray(state["length"])[lane, player])
         return [int(cells[(ptr + i) % MAXLEN]) for i in range(length)]
-
-    @staticmethod
-    def outcome_from_rank(rank_row) -> dict:
-        """Pairwise rank outcome (+-1/(P-1) per beaten/losing opponent),
-        identical to host outcome() (envs/hungry_geese.py:188-199)."""
-        out = {}
-        for p in range(NUM_AGENTS):
-            score = 0.0
-            for q in range(NUM_AGENTS):
-                if p == q:
-                    continue
-                if rank_row[p] > rank_row[q]:
-                    score += 1 / (NUM_AGENTS - 1)
-                elif rank_row[p] < rank_row[q]:
-                    score -= 1 / (NUM_AGENTS - 1)
-            out[p] = score
-        return out

@@ -1,0 +1,130 @@
+"""Vectorized Parallel (simultaneous-move) Tic-Tac-Toe as pure jnp
+transitions — the simultaneous-move counterpart of vector_tictactoe.py,
+driven by the streaming device rollout (runtime/device_rollout.py).
+
+Rules parity with the host env (envs/parallel_tictactoe.py:29-38, itself
+matching reference parallel_tictactoe.py:13-59): both players submit a
+legal move every step; a uniformly random submitter's action is applied
+with that player's color; the game ends on a completed line or a full
+board.  Lock-step parity is enforced by tests/test_device_rollout.py
+(device transitions replayed through the host ``_apply``).
+
+State (per lane):
+    cells        (B, 9) int8   0 empty / +1 player 0 / -1 player 1
+    winner       (B,)   int8
+    last_chooser (B,)   int8   whose action was applied last step (-1 none)
+    active       (B, P) bool   both players until the game ends
+    done         (B,)   bool
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tictactoe import WIN_LINES
+
+NUM_PLAYERS = 2
+NUM_ACTIONS = 9
+COLORS = (1, -1)  # player index -> stone color (host BLACK, WHITE)
+
+_LINES = np.asarray(WIN_LINES)  # (8, 3)
+
+
+class VectorParallelTicTacToe:
+    """Stateless namespace of batched transition functions."""
+
+    num_actions = NUM_ACTIONS
+    num_players = NUM_PLAYERS
+    max_steps = 9
+    simultaneous = True
+
+    @staticmethod
+    def init(n_lanes: int, key):
+        del key  # the empty board is deterministic
+        return {
+            "cells": jnp.zeros((n_lanes, 9), jnp.int8),
+            "winner": jnp.zeros((n_lanes,), jnp.int8),
+            "last_chooser": jnp.full((n_lanes,), -1, jnp.int8),
+            "active": jnp.ones((n_lanes, NUM_PLAYERS), bool),
+            "done": jnp.zeros((n_lanes,), bool),
+        }
+
+    @staticmethod
+    def reset_done(state, key):
+        from .vector_common import reset_where_done
+
+        fresh = VectorParallelTicTacToe.init(state["done"].shape[0], key)
+        return reset_where_done(fresh, state)
+
+    @staticmethod
+    def observation(state):
+        """(B, P, 3, 3, 3): per-player planes [always-acting ones, my
+        stones, opponent stones] (host observation(),
+        envs/parallel_tictactoe.py:59-70)."""
+        grid = state["cells"].reshape(-1, 1, 3, 3)           # (B, 1, 3, 3)
+        colors = jnp.asarray(COLORS, jnp.int8)[None, :, None, None]
+        mine = (grid == colors).astype(jnp.float32)
+        theirs = (grid == -colors).astype(jnp.float32)
+        ones = jnp.ones_like(mine)
+        return jnp.stack([ones, mine, theirs], axis=2)       # (B, P, 3, 3, 3)
+
+    @staticmethod
+    def legal_mask_all(state):
+        """(B, P, 9) bool — empty cells, identical for both players."""
+        empty = state["cells"] == 0                          # (B, 9)
+        return jnp.broadcast_to(empty[:, None, :], empty.shape[:1] + (NUM_PLAYERS, 9))
+
+    @staticmethod
+    def step(state, actions, key):
+        """Uniformly pick one player per lane and apply their action with
+        their color (host step(), envs/parallel_tictactoe.py:29-38);
+        finished lanes pass through unchanged."""
+        B = actions.shape[0]
+        live = ~state["done"] & (state["winner"] == 0)
+        chooser = jax.random.bernoulli(key, 0.5, (B,)).astype(jnp.int32)  # 0/1
+        action = jnp.take_along_axis(actions, chooser[:, None], axis=1)[:, 0]
+        color = jnp.where(chooser == 0, jnp.int8(1), jnp.int8(-1))
+
+        onehot = jax.nn.one_hot(action, 9, dtype=jnp.int8)
+        place = onehot * live[:, None].astype(jnp.int8)
+        cells = jnp.where(place > 0, color[:, None], state["cells"])
+
+        lines = cells[:, jnp.asarray(_LINES)]                # (B, 8, 3)
+        won = (lines.sum(axis=-1) == 3 * color[:, None].astype(jnp.int32)).any(axis=-1) & live
+        winner = jnp.where(won, color, state["winner"])
+
+        full = (cells != 0).all(axis=1)
+        ended = (winner != 0) | full
+        return {
+            "cells": cells,
+            "winner": winner,
+            "last_chooser": jnp.where(live, chooser.astype(jnp.int8), state["last_chooser"]),
+            "active": jnp.broadcast_to((~ended)[:, None], state["active"].shape),
+            "done": state["done"] | ended,
+        }
+
+    # -- streaming-rollout hooks --------------------------------------------
+
+    @staticmethod
+    def record(state):
+        return {"cells": state["cells"], "last_chooser": state["last_chooser"]}
+
+    @staticmethod
+    def outcome_scores(state):
+        """(B, P): (+1, -1) for a player-0 win, (-1, +1) for player 1, zeros
+        for a draw (host outcome(), envs/tictactoe.py:94-99)."""
+        w = state["winner"].astype(jnp.float32)
+        return jnp.stack([w, -w], axis=1)
+
+    @staticmethod
+    def episode_obs(compact, active):
+        """(T, P, 3, 3, 3) from recorded cells, mirroring observation()."""
+        cells = compact["cells"].astype(np.int8)             # (T, 9)
+        grid = cells.reshape(-1, 1, 3, 3)
+        colors = np.asarray(COLORS, np.int8)[None, :, None, None]
+        mine = (grid == colors).astype(np.float32)
+        theirs = (grid == -colors).astype(np.float32)
+        obs = np.stack([np.ones_like(mine), mine, theirs], axis=2)
+        return obs * active[..., None, None, None]

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import tree_map
 from .replay import compress_block
 
 ILLEGAL = 1e32
@@ -164,7 +165,7 @@ class DeviceRollout:
 # ---------------------------------------------------------------------------
 
 
-def build_streaming_fn(venv, module, n_lanes: int, k_steps: int):
+def build_streaming_fn(venv, module, n_lanes: int, k_steps: int, mesh=None):
     """Compile-once streaming self-play step for a simultaneous-move vector
     env (``venv.simultaneous``): ``fn(params, state, key) -> (state, record)``
     scans ``k_steps`` game steps over ``n_lanes`` persistent lanes,
@@ -173,7 +174,11 @@ def build_streaming_fn(venv, module, n_lanes: int, k_steps: int):
     StreamingDeviceRollout from the COMPACT per-step record (occupancy +
     heads + food, not full observation planes) — ~40x less HBM->host
     traffic than shipping the 17-plane observations, which the host
-    reconstructs with pure numpy scatter ops."""
+    reconstructs with pure numpy scatter ops.
+
+    With ``mesh``, lanes shard over the mesh's 'dp' axis (params
+    replicated): one SPMD program steps n_lanes games across all devices,
+    the self-play analogue of the data-parallel train step."""
 
     def fn(params, state, key):
         def body(state, key_t):
@@ -186,11 +191,12 @@ def build_streaming_fn(venv, module, n_lanes: int, k_steps: int):
             flat = obs.reshape((B * P,) + obs.shape[2:])
             out = module.apply({"params": params}, flat, None)
             logits = out["policy"].astype(jnp.float32).reshape(B, P, -1)
-            # every action is legal in these envs (reversal is legal-but-
-            # lethal, host legal_actions); Gumbel-max == softmax sampling
-            g = jax.random.gumbel(ka, logits.shape)
-            action = jnp.argmax(logits + g, axis=-1).astype(jnp.int32)
-            probs = jax.nn.softmax(logits, axis=-1)
+            legal = venv.legal_mask_all(state)           # (B, P, A) bool
+            masked = jnp.where(legal, logits, logits - ILLEGAL)
+            # Gumbel-max == softmax sampling at temperature 1 (generation.py)
+            g = jax.random.gumbel(ka, masked.shape)
+            action = jnp.argmax(masked + g, axis=-1).astype(jnp.int32)
+            probs = jax.nn.softmax(masked, axis=-1)
             prob = jnp.take_along_axis(probs, action[..., None], axis=-1)[..., 0]
             value = (
                 out["value"].reshape(B, P)
@@ -200,78 +206,80 @@ def build_streaming_fn(venv, module, n_lanes: int, k_steps: int):
             record = {
                 "reset": reset,
                 "active": active,
-                "occ": state["occ"],
-                "head": venv.head_cell(state).astype(jnp.int8),
-                "tail": venv.tail_cell(state).astype(jnp.int8),
-                "prev_head": state["prev_head"].astype(jnp.int8),
-                "food": state["food"],
-                "action": action.astype(jnp.int8),
+                "legal": legal,
+                "action": action.astype(jnp.int32),
                 "prob": prob,
                 "value": value,
             }
+            record.update(venv.record(state))   # env's compact obs fields
             state = venv.step(state, action, kf)
             record["done"] = state["done"]   # reset_done cleared stale flags
-            record["rank"] = state["rank"]   # final ranks where done
+            record["outcome"] = venv.outcome_scores(state)  # final where done
             return state, record
 
         return jax.lax.scan(body, state, jax.random.split(key, k_steps))
 
-    return jax.jit(fn, donate_argnums=(1,))
+    if mesh is None:
+        return jax.jit(fn, donate_argnums=(1,))
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    lanes = NamedSharding(mesh, PartitionSpec("dp"))            # state: (B, ...)
+    rec = NamedSharding(mesh, PartitionSpec(None, "dp"))        # record: (K, B, ...)
+    rep = NamedSharding(mesh, PartitionSpec())
+    return jax.jit(
+        fn,
+        donate_argnums=(1,),
+        in_shardings=(rep, lanes, rep),
+        out_shardings=(lanes, rec),
+    )
 
 
 def _streaming_episode(venv, steps: List[tuple], done_rec, done_k: int, lane: int,
                        args: Dict[str, Any]) -> Dict[str, Any]:
     """Assemble one finished lane into the standard columnar episode.
 
-    ``steps`` is the lane's buffered [(record, k)] history (possibly
-    spanning several device calls); observation planes are rebuilt from the
-    compact occupancy record exactly as the host env builds them
-    (envs/hungry_geese.py:242-256) — pinned against the host by
-    tests/test_device_rollout.py."""
+    ``steps`` is the lane's buffered [(record, k_start, k_end)] span
+    history (possibly spanning several device calls); observations are
+    rebuilt host-side from the env's compact record fields
+    (``venv.episode_obs``) — pinned against the host env's observation()
+    by tests/test_device_rollout.py."""
     P = venv.num_players
-    A = venv.num_actions
-    T = len(steps)
+    T = sum(k1 - k0 for _, k0, k1 in steps)
     b = lane
 
-    def gather(name, dtype=np.float32):
-        return np.stack([np.asarray(rec[name][k][b]) for rec, k in steps]).astype(dtype)
+    def gather(name, dtype=None):
+        out = np.concatenate(
+            [np.asarray(rec[name][k0:k1, b]) for rec, k0, k1 in steps]
+        )
+        return out if dtype is None else out.astype(dtype)
 
-    occ = gather("occ")                    # (T, P, C) 0/1
-    head = gather("head", np.int32)        # (T, P) -1 absent
-    tail = gather("tail", np.int32)
-    prev = gather("prev_head", np.int32)
-    food = gather("food")                  # (T, C)
-    action = gather("action", np.int32)
-    prob = gather("prob")
-    value = gather("value")
-    active = gather("active")              # (T, P) 0/1
+    action = gather("action", np.int32)    # (T, P)
+    prob = gather("prob", np.float32)
+    value = gather("value", np.float32)
+    active = gather("active", np.float32)  # (T, P) 0/1
+    legal = gather("legal")                # (T, P, A) bool
+    compact = {
+        name: gather(name)
+        for name in steps[0][0]
+        if name not in ("reset", "active", "legal", "action", "prob", "value",
+                        "done", "outcome")
+    }
+    obs = venv.episode_obs(compact, active)          # (T, P, ...)
 
-    C = occ.shape[-1]
-    cell_ids = np.arange(C, dtype=np.int32)
-    heads_oh = (head[..., None] == cell_ids).astype(np.float32)   # (T, P, C)
-    tails_oh = (tail[..., None] == cell_ids).astype(np.float32)
-    prev_oh = (prev[..., None] == cell_ids).astype(np.float32)
-    food_pl = food[:, None, :]
-
-    views = []
-    for p in range(P):
-        planes = np.concatenate(
-            [
-                np.roll(heads_oh, -p, axis=1),
-                np.roll(tails_oh, -p, axis=1),
-                np.roll(occ, -p, axis=1),
-                np.roll(prev_oh, -p, axis=1),
-                food_pl,
-            ],
-            axis=1,
-        )  # (T, 4*P+1, C)
-        views.append(planes * active[:, p, None, None])
-    obs = np.stack(views, axis=1)  # (T, P, planes, C)
-    obs = obs.reshape(obs.shape[:3] + venv.board_shape)
-
-    final_rank = np.asarray(done_rec["rank"][done_k][b])
-    outcome = venv.outcome_from_rank(final_rank)
+    final = np.asarray(done_rec["outcome"][done_k][b], np.float32)
     players = list(range(P))
+    outcome = {p: float(final[p]) for p in players}
+
+    # per-step reward (constant-per-step envs, e.g. Geister's -0.01) and
+    # its discounted return-to-go (generation.py:78-82)
+    step_reward = float(getattr(venv, "step_reward", 0.0))
+    reward = np.full((T, P), step_reward, np.float32)
+    ret = np.zeros((T, P), np.float32)
+    if step_reward:
+        acc = np.zeros(P, np.float32)
+        for t in range(T - 1, -1, -1):
+            acc = reward[t] + args["gamma"] * acc
+            ret[t] = acc
 
     block_len = args["compress_steps"]
     blocks = []
@@ -279,16 +287,17 @@ def _streaming_episode(venv, steps: List[tuple], done_rec, done_k: int, lane: in
         hi = min(lo + block_len, T)
         t = hi - lo
         act = active[lo:hi]
+        amask = np.where(
+            legal[lo:hi] & (act[..., None] > 0), 0.0, ILLEGAL
+        ).astype(np.float32)
         cols = {
-            "obs": obs[lo:hi],
+            "obs": tree_map(lambda x: x[lo:hi], obs),
             "prob": np.where(act > 0, prob[lo:hi], 1.0).astype(np.float32),
             "action": (action[lo:hi] * (act > 0)).astype(np.int32),
-            "amask": np.broadcast_to(
-                np.where(act[..., None] > 0, 0.0, ILLEGAL), (t, P, A)
-            ).astype(np.float32),
+            "amask": amask,
             "value": (value[lo:hi] * act).astype(np.float32),
-            "reward": np.zeros((t, P), np.float32),
-            "ret": np.zeros((t, P), np.float32),
+            "reward": reward[lo:hi] * act,
+            "ret": ret[lo:hi],
             "tmask": act.astype(np.float32),
             "omask": act.astype(np.float32),
             "turn": np.argmax(act, axis=1).astype(np.int32),
@@ -304,12 +313,13 @@ def _streaming_episode(venv, steps: List[tuple], done_rec, done_k: int, lane: in
     }
 
 
-def make_device_rollout(venv, module, args: Dict[str, Any], n_games: int):
+def make_device_rollout(venv, module, args: Dict[str, Any], n_games: int, mesh=None):
     """Pick the rollout driver for a vector env: episodic single-call
     games for strict-alternation envs (VectorTicTacToe), persistent
-    streaming lanes for simultaneous-move envs (VectorHungryGeese)."""
+    streaming lanes for simultaneous-move envs (VectorHungryGeese) —
+    lanes sharded over the mesh's 'dp' axis when a mesh is given."""
     if getattr(venv, "simultaneous", False):
-        return StreamingDeviceRollout(venv, module, args, n_lanes=n_games)
+        return StreamingDeviceRollout(venv, module, args, n_lanes=n_games, mesh=mesh)
     return DeviceRollout(venv, module, args, n_games)
 
 
@@ -329,43 +339,66 @@ class StreamingDeviceRollout:
     """
 
     def __init__(self, venv, module, args: Dict[str, Any], n_lanes: int = 256,
-                 k_steps: int = 32):
+                 k_steps: int = 32, mesh=None):
+        if mesh is not None:
+            dp = mesh.shape.get("dp", 1)
+            if n_lanes % dp:
+                raise ValueError(f"n_lanes {n_lanes} not divisible by dp axis {dp}")
         self.venv = venv
         self.args = args
         self.n_lanes = n_lanes
         self.k_steps = k_steps
-        self._fn = build_streaming_fn(venv, module, n_lanes, k_steps)
+        self._fn = build_streaming_fn(venv, module, n_lanes, k_steps, mesh)
         self._state = None
+        self._pending = None         # in-flight device record (one-call pipeline)
         self._partial: List[List[tuple]] = [[] for _ in range(n_lanes)]
         self.game_steps = 0          # lifetime game-steps (>=1 goose acting)
         self.player_steps = 0        # lifetime per-player acting steps
 
     def generate(self, params, key) -> List[Dict[str, Any]]:
+        """Advance all lanes k_steps and return episodes finished one call
+        ago: the device computes block N while the host transfers and
+        assembles block N-1 (jax dispatch is async; only the device_get
+        synchronizes), so host-side episode assembly is hidden behind
+        device compute instead of serializing with it."""
         import jax as _jax
 
         if self._state is None:
             key, k0 = _jax.random.split(key)
             self._state = self.venv.init(self.n_lanes, k0)
-        self._state, record = self._fn(params, self._state, key)
+        self._state, record = self._fn(params, self._state, key)  # async
+        record, self._pending = self._pending, record
+        if record is None:
+            return []
         record = _jax.device_get(record)
 
         active = record["active"]                    # (K, B, P)
         self.game_steps += int((active.sum(axis=2) > 0).sum())
         self.player_steps += int(active.sum())
 
+        # span bookkeeping: one (record, k0, k1) entry per lane per call in
+        # the common case — not one append per lane per STEP, which at
+        # 512 lanes x 32 steps costs ~16k interpreter appends on the very
+        # host thread the compute/assembly overlap is keeping light
         episodes = []
-        reset = record["reset"]
-        done = record["done"]
-        for k in range(self.k_steps):
-            for b in np.flatnonzero(reset[k]):
-                self._partial[b] = []    # lane restarted (episode already flushed)
-            for b in range(self.n_lanes):
-                self._partial[b].append((record, k))
-            for b in np.flatnonzero(done[k]):
+        done = record["done"]                        # (K, B)
+        lane_has_done = done.any(axis=0)
+        K = self.k_steps
+        for b in range(self.n_lanes):
+            if not lane_has_done[b]:
+                self._partial[b].append((record, 0, K))
+                continue
+            seg = 0
+            for kd in np.flatnonzero(done[:, b]):
+                kd = int(kd)
+                self._partial[b].append((record, seg, kd + 1))
                 episodes.append(
                     _streaming_episode(
-                        self.venv, self._partial[b], record, k, b, self.args
+                        self.venv, self._partial[b], record, kd, b, self.args
                     )
                 )
                 self._partial[b] = []
+                seg = kd + 1        # the lane resets at kd + 1 (next episode)
+            if seg < K:
+                self._partial[b].append((record, seg, K))
         return episodes

@@ -28,6 +28,7 @@ import random
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError  # plain Exception subclass until py3.11
 from typing import Any, Dict, List, Optional
 
 from ..envs import make_env, prepare_env
@@ -120,6 +121,15 @@ class Learner:
                     f"{args['env_args'].get('env')} exposes no vector_env()"
                 )
             self._venv = vector_env()
+            # constructed HERE so misconfiguration (e.g. lane count not
+            # divisible by the mesh's dp axis) fails the run at startup
+            # instead of silently killing the rollout daemon thread
+            from .device_rollout import make_device_rollout
+
+            self._device_roll = make_device_rollout(
+                self._venv, self.module, self.args, self._device_games,
+                mesh=self.trainer.ctx.mesh,
+            )
 
     # -- request plumbing ---------------------------------------------------
 
@@ -337,9 +347,7 @@ class Learner:
         flooding the store)."""
         import jax
 
-        from .device_rollout import make_device_rollout
-
-        roll = make_device_rollout(self._venv, self.module, self.args, self._device_games)
+        roll = self._device_roll
         key = jax.random.PRNGKey(self.args["seed"] + 0x5EED)
         while not self.shutdown_flag:
             if self.num_returned_episodes >= self._next_update_episodes:
@@ -362,7 +370,7 @@ class Learner:
             while not fut.done():
                 try:
                     fut.result(timeout=5.0)
-                except TimeoutError:
+                except (TimeoutError, FutureTimeoutError):
                     if self.shutdown_flag:
                         return  # server draining/exited; nothing to feed
                 except Exception:

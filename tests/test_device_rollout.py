@@ -180,6 +180,10 @@ class TestVectorGeeseParity:
     def test_lockstep_greedy_reaches_hunger(self):
         """Greedy survival policy: games must live past step 40 so the
         hunger tail-pop (t % 40 == 0) and long-body dynamics are covered."""
+        import random as _random
+
+        _random.seed(7)  # rule_based_action falls back to random.choice
+
         def policy(hosts, rng):
             acts = np.zeros((len(hosts), 4), np.int32)
             for b, host in enumerate(hosts):
@@ -192,6 +196,53 @@ class TestVectorGeeseParity:
 
         finished, max_step = self._run_lockstep(12, 70, 7, policy)
         assert max_step > 40, "no game survived past the hunger step"
+
+    def test_contested_food_goes_to_lowest_index(self):
+        """Host food consumption is sequential: when two geese reach the
+        same food, only the lower-indexed one eats; the loser pops its
+        tail, which a THIRD goose colliding with that tail cell observes
+        (it survives iff the tail was popped).  Regression for the
+        parallel-eat shortcut that kept the loser's tail."""
+        from handyrl_tpu.envs.vector_hungry_geese import (
+            MAXLEN, VectorHungryGeese as V,
+        )
+
+        # board cells r*11+c: food F=38 at (3,5); goose 0 head 37 moves E;
+        # goose 1 body [39, 40] moves W (loses the food race, pops 40);
+        # goose 2 head 29 moves S onto 40 (survives iff 40 was popped);
+        # goose 3 far away at 66 moves N.
+        cells = np.zeros((1, 4, MAXLEN), np.int32)
+        cells[0, 0, 0] = 37
+        cells[0, 1, 0], cells[0, 1, 1] = 39, 40
+        cells[0, 2, 0] = 29
+        cells[0, 3, 0] = 66
+        occ = np.zeros((1, 4, 77), np.int8)
+        for p, body in enumerate([[37], [39, 40], [29], [66]]):
+            occ[0, p, body] = 1
+        food = np.zeros((1, 77), np.int8)
+        food[0, [38, 76]] = 1
+        state = {
+            "cells": jnp.asarray(cells),
+            "head_ptr": jnp.zeros((1, 4), jnp.int32),
+            "length": jnp.asarray([[1, 2, 1, 1]], jnp.int32),
+            "occ": jnp.asarray(occ),
+            "active": jnp.ones((1, 4), bool),
+            "last_action": jnp.full((1, 4), -1, jnp.int32),
+            "prev_head": jnp.full((1, 4), -1, jnp.int32),
+            "rank": jnp.full((1, 4), 101, jnp.int32),
+            "food": jnp.asarray(food),
+            "step": jnp.zeros((1,), jnp.int32),
+            "done": jnp.zeros((1,), bool),
+        }
+        actions = jnp.asarray([[3, 2, 1, 0]], jnp.int32)  # E, W, S, N
+        out = V.step(state, actions, jax.random.PRNGKey(0))
+        active = np.asarray(out["active"])[0]
+        # geese 0 and 1 share head cell 38 and both die; goose 2 must
+        # SURVIVE because goose 1 did not eat and popped its tail at 40
+        assert list(active) == [False, False, True, True]
+        assert V.body_list(out, 0, 2) == [40]
+        # the contested food is consumed exactly once
+        assert np.asarray(out["food"])[0, 38] == 0
 
     def test_food_spawn_uniform_and_valid(self):
         """Device food spawns land only on free cells and cover the board
@@ -221,7 +272,7 @@ class TestStreamingRollout:
     """StreamingDeviceRollout: persistent lanes, auto-reset, episode
     stitching across calls, columnar schema, trainability."""
 
-    def _episodes(self, n_calls=6, n_lanes=32, k_steps=16, seed=0):
+    def _episodes(self, n_calls=6, n_lanes=32, k_steps=16, seed=0, mesh=None):
         from handyrl_tpu.envs.vector_hungry_geese import VectorHungryGeese
         from handyrl_tpu.runtime.device_rollout import StreamingDeviceRollout
 
@@ -236,7 +287,8 @@ class TestStreamingRollout:
         args = dict(cfg["train_args"])
         args["env"] = cfg["env_args"]
         roll = StreamingDeviceRollout(
-            VectorHungryGeese, module, args, n_lanes=n_lanes, k_steps=k_steps
+            VectorHungryGeese, module, args, n_lanes=n_lanes, k_steps=k_steps,
+            mesh=mesh,
         )
         key = jax.random.PRNGKey(seed)
         episodes = []
@@ -257,8 +309,8 @@ class TestStreamingRollout:
             assert obs.shape[1:] == (4, 17, 7, 11)
             assert amask.shape[1:] == (4, 4)  # full action dim (mixes with host episodes)
             assert sum(c["prob"].shape[0] for c in cols) == ep["steps"]
-            # zero-sum pairwise rank outcome
-            assert abs(sum(ep["outcome"].values())) < 1e-9
+            # zero-sum pairwise rank outcome (fp32 on device: 1/3 rounds)
+            assert abs(sum(ep["outcome"].values())) < 1e-6
             # all four geese act at step one; actors strictly shrink
             n_act = tmask.sum(axis=1)
             assert n_act[0] == 4.0
@@ -271,7 +323,9 @@ class TestStreamingRollout:
         observation() for the same reconstructed position."""
         from handyrl_tpu.envs.hungry_geese import Environment
 
-        env, module, variables, args, roll, episodes = self._episodes(n_calls=3)
+        # one block is always in flight (compute/assembly overlap), so
+        # n_calls=4 assembles 3 blocks = 48 steps — past the t=40 die-off
+        env, module, variables, args, roll, episodes = self._episodes(n_calls=4)
         checked = 0
         for ep in episodes[:8]:
             cols = [decompress_block(b) for b in ep["blocks"]]
@@ -311,6 +365,23 @@ class TestStreamingRollout:
         m = jax.device_get(metrics)
         assert np.isfinite(m["total"]) and m["dcnt"] > 0
 
+    def test_sharded_lanes_over_mesh(self):
+        """Streaming rollout as one SPMD program: lanes sharded over the
+        8-device CPU mesh's 'dp' axis, params replicated — the actor-plane
+        analogue of the data-parallel train step."""
+        from handyrl_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"dp": -1})
+        env, module, variables, args, roll, episodes = self._episodes(
+            n_calls=7, n_lanes=16, k_steps=8, mesh=mesh
+        )
+        assert episodes, "sharded rollout produced no episodes"
+        for ep in episodes[:4]:
+            cols = [decompress_block(b) for b in ep["blocks"]]
+            obs = np.concatenate([c["obs"] for c in cols])
+            assert obs.shape[1:] == (4, 17, 7, 11)
+            assert abs(sum(ep["outcome"].values())) < 1e-9
+
     def test_lanes_stitch_across_calls(self):
         """Episodes longer than k_steps must span device calls.  The
         freshly-initialized GeeseNet is near-deterministic (large logit
@@ -322,6 +393,100 @@ class TestStreamingRollout:
         )
         assert episodes, "no episode finished in 48 steps"
         assert max(ep["steps"] for ep in episodes) > 4
+
+
+class TestVectorParallelTicTacToe:
+    """Streaming rollout on the simultaneous-move TicTacToe variant:
+    device games must replay exactly through the host rules."""
+
+    def _episodes(self, n_calls=6, n_lanes=24, k_steps=6):
+        from handyrl_tpu.envs.vector_parallel_tictactoe import VectorParallelTicTacToe
+        from handyrl_tpu.runtime.device_rollout import StreamingDeviceRollout
+
+        env = make_env({"env": "ParallelTicTacToe"})
+        module = env.net()
+        variables = init_variables(module, env)
+        cfg = normalize_args({
+            "env_args": {"env": "ParallelTicTacToe"},
+            "train_args": {"batch_size": 8, "forward_steps": 4,
+                           "turn_based_training": False, "observation": False},
+        })
+        args = dict(cfg["train_args"])
+        args["env"] = cfg["env_args"]
+        roll = StreamingDeviceRollout(
+            VectorParallelTicTacToe, module, args, n_lanes=n_lanes, k_steps=k_steps
+        )
+        key = jax.random.PRNGKey(11)
+        episodes = []
+        for _ in range(n_calls):
+            key, sub = jax.random.split(key)
+            episodes += roll.generate(variables["params"], sub)
+        return env, args, episodes
+
+    def test_replays_through_host_rules(self):
+        env, args, episodes = self._episodes()
+        assert len(episodes) > 20
+        checked_steps = 0
+        for ep in episodes:
+            cols = [decompress_block(b) for b in ep["blocks"]]
+            obs = np.concatenate([c["obs"] for c in cols])
+            action = np.concatenate([c["action"] for c in cols])
+            tmask = np.concatenate([c["tmask"] for c in cols])
+            amask = np.concatenate([c["amask"] for c in cols])
+            T = ep["steps"]
+            # rebuild the board-before-step from player 0's view planes
+            boards = (obs[:, 0, 1] - obs[:, 0, 2]).reshape(T, 9)  # +1/-1 stones
+            env.reset()
+            for t in range(T):
+                assert (tmask[t] == 1.0).all()  # both players act every step
+                np.testing.assert_array_equal(env.cells, boards[t])
+                # active rows carry the empty-cell legal mask
+                np.testing.assert_array_equal(
+                    amask[t, 0] == 0.0, env.cells == 0
+                )
+                if t + 1 < T:
+                    diff = boards[t + 1] - boards[t]
+                    placed = np.flatnonzero(diff)
+                    assert len(placed) == 1
+                    chooser = 0 if diff[placed[0]] > 0 else 1
+                    assert action[t, chooser] == placed[0]
+                    env._apply(int(placed[0]), chooser)
+                    assert not env.terminal()
+                    checked_steps += 1
+                else:
+                    # final step: the true chooser's action must end the
+                    # game with the recorded outcome
+                    found = False
+                    for chooser in (0, 1):
+                        trial = make_env(args["env"])
+                        trial.reset()
+                        trial.cells = boards[t].astype(trial.cells.dtype).copy()
+                        # host terminal() counts history; seed it with the
+                        # stones already on the board
+                        trial.history = [(0, 0)] * int((trial.cells != 0).sum())
+                        trial._apply(int(action[t, chooser]), chooser)
+                        if trial.terminal() and trial.outcome() == ep["outcome"]:
+                            found = True
+                            break
+                    assert found, (t, ep["outcome"])
+        assert checked_steps > 50
+
+    def test_chooser_is_fair(self):
+        """The applied action comes from each player ~half the time."""
+        env, args, episodes = self._episodes(n_calls=8)
+        by = [0, 0]
+        for ep in episodes:
+            cols = [decompress_block(b) for b in ep["blocks"]]
+            obs = np.concatenate([c["obs"] for c in cols])
+            T = ep["steps"]
+            boards = (obs[:, 0, 1] - obs[:, 0, 2]).reshape(T, 9)
+            for t in range(T - 1):
+                diff = boards[t + 1] - boards[t]
+                placed = np.flatnonzero(diff)
+                by[0 if diff[placed[0]] > 0 else 1] += 1
+        total = sum(by)
+        assert total > 100
+        assert 0.35 < by[0] / total < 0.65
 
 
 def test_learner_with_device_rollouts(tmp_path, monkeypatch):
