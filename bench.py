@@ -388,28 +388,26 @@ def _device_selfplay_bench(duration: float):
     }
 
 
-def _geese_device_selfplay_bench(duration: float, n_lanes: int = 256, k_steps: int = 32):
-    """Streaming on-device HungryGeese self-play: persistent lanes with
-    auto-reset, env stepping + GeeseNet inference + sampling in one jit
-    per k_steps block (runtime/device_rollout.py:StreamingDeviceRollout).
-    This is the north-star actor plane with zero host round-trips per
-    step; episode assembly (compact-record -> columnar) runs inside the
-    timed window, so the number is end-to-end."""
+def _streaming_selfplay_bench(env_name: str, overrides, duration: float,
+                              n_lanes: int = 256, k_steps: int = 32):
+    """Streaming on-device self-play: persistent lanes with auto-reset,
+    env stepping + net inference + sampling in one jit per k_steps block
+    (runtime/device_rollout.py:StreamingDeviceRollout).  This is the
+    actor plane with zero host round-trips per step; episode assembly
+    (compact-record -> columnar) runs inside the timed window, so the
+    number is end-to-end."""
     import jax
 
     from handyrl_tpu.envs import make_env
-    from handyrl_tpu.envs.vector_hungry_geese import VectorHungryGeese
     from handyrl_tpu.models import init_variables
     from handyrl_tpu.runtime.device_rollout import StreamingDeviceRollout
 
-    args = _make_args(
-        "HungryGeese", {"turn_based_training": False, "observation": False}
-    )
+    args = _make_args(env_name, overrides)
     env = make_env(args["env"])
     module = env.net()
     params = init_variables(module, env)["params"]
     roll = StreamingDeviceRollout(
-        VectorHungryGeese, module, args, n_lanes=n_lanes, k_steps=k_steps
+        env.vector_env(), module, args, n_lanes=n_lanes, k_steps=k_steps
     )
     key = jax.random.PRNGKey(0)
     key, sub = jax.random.split(key)
@@ -420,7 +418,8 @@ def _geese_device_selfplay_bench(duration: float, n_lanes: int = 256, k_steps: i
     while time.perf_counter() - t0 < duration:
         key, sub = jax.random.split(key)
         n_eps += len(roll.generate(params, sub))
-    dt = time.perf_counter() - t0
+    roll.drain()  # the overlap leaves one block in flight; exiting with it
+    dt = time.perf_counter() - t0  # running aborts the process at teardown
     return {
         "env_steps_per_sec": (roll.game_steps - steps0) / dt,
         "player_steps_per_sec": (roll.player_steps - psteps0) / dt,
@@ -529,7 +528,9 @@ def main() -> None:
 
     # 1c. north-star actor plane, on-device: streaming HungryGeese self-play
     try:
-        gd = _geese_device_selfplay_bench(T_GEN / 2)
+        gd = _streaming_selfplay_bench(
+            "HungryGeese", geese_over, T_GEN / 2
+        )
         result["extra"]["geese_device_selfplay_env_steps_per_sec"] = round(
             gd["env_steps_per_sec"], 1
         )
@@ -613,6 +614,22 @@ def main() -> None:
         )
     except Exception:
         result["error"] = (result["error"] or "") + " geister: " + traceback.format_exc(limit=3)
+
+    # 4b. recurrent on-device self-play: Geister with the DRC ConvLSTM —
+    # turn-based streaming lanes carrying per-player hidden state
+    try:
+        gsd = _streaming_selfplay_bench(
+            "Geister", {"observation": True}, T_GEN / 2,
+            n_lanes=128, k_steps=32,
+        )
+        result["extra"]["geister_device_selfplay_env_steps_per_sec"] = round(
+            gsd["env_steps_per_sec"], 1
+        )
+        result["extra"]["geister_device_selfplay_episodes_per_sec"] = round(
+            gsd["episodes_per_sec"], 2
+        )
+    except Exception:
+        result["error"] = (result["error"] or "") + " geister-device-selfplay: " + traceback.format_exc(limit=3)
 
     # 5. seq-attention kernel crossover (einsum vs Pallas flash, fwd+bwd)
     try:

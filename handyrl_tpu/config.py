@@ -112,12 +112,9 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError("train_args.fused_steps must be >= 1")
     if train["device_rollout_games"] < 0:
         raise ValueError("train_args.device_rollout_games must be >= 0")
-    if train["device_rollout_games"] > 0 and train["observation"]:
-        raise ValueError(
-            "device_rollout_games does not support observation: true — "
-            "device episodes record the turn player only (no observer "
-            "views); use host actors for observer-trained recurrent models"
-        )
+    # observation: true with device_rollout_games is validated per-env at
+    # Learner startup: streaming vector envs with an observe_mask hook
+    # (Geister) record observer views; turn-player-only envs must refuse
     if not 0.0 <= train["eval_rate"] <= 1.0:
         raise ValueError("train_args.eval_rate must be in [0, 1]")
     if train["seq_attention"] not in ("auto", "flash", "einsum", "ring"):

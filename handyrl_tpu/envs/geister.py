@@ -328,6 +328,14 @@ class Environment(BaseEnvironment):
     def action_size(self):
         return 214  # 144 move + 70 layout logits
 
+    @staticmethod
+    def vector_env():
+        """Device-resident batched rules (streaming on-device self-play
+        with the recurrent DRC net, runtime/device_rollout.py)."""
+        from .vector_geister import VectorGeister
+
+        return VectorGeister
+
     def transformer_spec(self):
         return {"num_actions": self.action_size(), "with_return": True}
 

@@ -1,0 +1,429 @@
+"""Vectorized Geister as pure jnp state transitions (device-resident).
+
+The host env (envs/geister.py) is the canonical rules implementation;
+this module expresses the SAME rules as batched, branch-free array ops:
+whole populations of games — each possibly in a different phase (piece
+placement at ply -2/-1, mid-game, finished) — step together under one
+``lax.scan``, with every branch realized as a masked update.  Drives the
+streaming device rollout (runtime/device_rollout.py) with the DRC
+ConvLSTM net: the first turn-based + recurrent on-device self-play path.
+
+Rules parity with the host (lock-step tested in
+tests/test_device_rollout.py::TestVectorGeisterParity):
+
+* action space 144 move (dir*36 + square in the MOVER's frame; White
+  sees the board 180-degree rotated, frame_sq = 35 - sq, frame_dir =
+  3 - d) + 70 placement layouts (C(8,4) blue assignments);
+* captures disclose nothing here (the device is the omniscient master;
+  information hiding happens in observation building, exactly like the
+  host's per-player planes);
+* win by goal escape / capturing all enemy blues / capturing all enemy
+  reds (mover LOSES), 200-ply draw, -0.01 per-step reward for both
+  players (host geister.py:183-214, 253-261).
+
+State (per lane):
+    board  (B, 36) int8   piece id 0..15 or -1 (6x6 in x*6+y order)
+    pos    (B, 16) int8   square of each piece, -1 when off-board
+    kind   (B, 16) int8   BLUE 0 / RED 1 (true kinds)
+    alive  (B, 16) bool
+    counts (B, 2, 2) int8 remaining per (color, kind)
+    ply    (B,) int32     starts at -2 (two placement plies)
+    win    (B,) int8      -1 none / 0 Black / 1 White / 2 draw
+    active (B, 2) bool    one-hot of the player to act (zeros when done)
+    done   (B,) bool
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NUM_PLAYERS = 2
+BLUE, RED = 0, 1
+SIZE = 6
+NUM_SQUARES = 36
+NUM_MOVE_ACTIONS = 144
+NUM_ACTIONS = 214
+MAX_PLY = 200
+STEP_REWARD = -0.01
+
+# (x, y) deltas in host order [up, left, right, down] (geister.py:34)
+_DIRS = np.array([(-1, 0), (0, -1), (0, 1), (1, 0)], np.int32)
+
+# home squares (x*6+y) in placement order per color (host _HOME)
+_HOME = np.array(
+    [
+        [1 * 6 + 1, 2 * 6 + 1, 3 * 6 + 1, 4 * 6 + 1, 1 * 6 + 0, 2 * 6 + 0, 3 * 6 + 0, 4 * 6 + 0],
+        [4 * 6 + 4, 3 * 6 + 4, 2 * 6 + 4, 1 * 6 + 4, 4 * 6 + 5, 3 * 6 + 5, 2 * 6 + 5, 1 * 6 + 5],
+    ],
+    np.int32,
+)
+
+# layout index -> which of the 8 home slots hold blue pieces (host LAYOUTS)
+_LAYOUT_BLUES = np.zeros((70, 8), bool)
+for _i, _combo in enumerate(itertools.combinations(range(8), 4)):
+    _LAYOUT_BLUES[_i, list(_combo)] = True
+
+HOME = jnp.asarray(_HOME)
+LAYOUT_BLUES = jnp.asarray(_LAYOUT_BLUES)
+DIRX = jnp.asarray(_DIRS[:, 0])
+DIRY = jnp.asarray(_DIRS[:, 1])
+
+
+def _frame_sq(sq, color):
+    """Board square <-> mover-frame square (White: 180-degree rotation)."""
+    return jnp.where(color == 1, 35 - sq, sq)
+
+
+def _frame_dir(d, color):
+    return jnp.where(color == 1, 3 - d, d)
+
+
+class VectorGeister:
+    """Stateless namespace of batched transition functions."""
+
+    num_actions = NUM_ACTIONS
+    num_players = NUM_PLAYERS
+    max_steps = MAX_PLY + 2
+    simultaneous = False          # strict alternation, driver samples turn player
+    step_reward = STEP_REWARD
+
+    @staticmethod
+    def init(n_lanes: int, key):
+        del key  # placement layouts come from the policy, not env RNG
+        B = n_lanes
+        active = jnp.zeros((B, NUM_PLAYERS), bool).at[:, 0].set(True)
+        return {
+            "board": jnp.full((B, NUM_SQUARES), -1, jnp.int8),
+            "pos": jnp.full((B, 16), -1, jnp.int8),
+            "kind": jnp.zeros((B, 16), jnp.int8),
+            "alive": jnp.zeros((B, 16), bool),
+            "counts": jnp.zeros((B, 2, 2), jnp.int8),
+            "ply": jnp.full((B,), -2, jnp.int32),
+            "win": jnp.full((B,), -1, jnp.int8),
+            "active": active,
+            "done": jnp.zeros((B,), bool),
+        }
+
+    @staticmethod
+    def reset_done(state, key):
+        from .vector_common import reset_where_done
+
+        fresh = VectorGeister.init(state["done"].shape[0], key)
+        return reset_where_done(fresh, state)
+
+    # -- transition ---------------------------------------------------------
+
+    @staticmethod
+    def step(state, actions, key):
+        """Apply the turn player's action in every running lane; placement
+        and move plies are handled as masked branches of one update
+        (host play(), geister.py:183-214)."""
+        del key
+        B = actions.shape[0]
+        rows = jnp.arange(B)
+        live = ~state["done"] & (state["win"] == -1)
+        c = (state["ply"] % 2).astype(jnp.int32)            # turn color
+        a = jnp.take_along_axis(actions, c[:, None], axis=1)[:, 0]
+
+        board, pos = state["board"], state["pos"]
+        kind, alive, counts = state["kind"], state["alive"], state["counts"]
+        win = state["win"]
+
+        # ---- placement branch (ply < 0, host _place geister.py:163-175) ----
+        setting = live & (state["ply"] < 0)
+        layout = jnp.clip(a - NUM_MOVE_ACTIONS, 0, 69)
+        blues = LAYOUT_BLUES[layout]                         # (B, 8)
+        pids = c[:, None] * 8 + jnp.arange(8)[None, :]       # (B, 8)
+        homes = HOME[c]                                      # (B, 8)
+        sm = setting[:, None]
+        pos = pos.at[rows[:, None], pids].set(
+            jnp.where(sm, homes.astype(jnp.int8), jnp.take_along_axis(pos, pids, axis=1))
+        )
+        kind = kind.at[rows[:, None], pids].set(
+            jnp.where(
+                sm,
+                jnp.where(blues, jnp.int8(BLUE), jnp.int8(RED)),
+                jnp.take_along_axis(kind, pids, axis=1),
+            )
+        )
+        alive = alive.at[rows[:, None], pids].set(
+            sm | jnp.take_along_axis(alive, pids, axis=1)
+        )
+        board = board.at[rows[:, None], homes].set(
+            jnp.where(sm, pids.astype(jnp.int8), jnp.take_along_axis(board, homes, axis=1))
+        )
+        counts = counts.at[rows, c].set(
+            jnp.where(sm, jnp.int8(4), counts[rows, c])
+        )
+
+        # ---- move branch (ply >= 0, host play geister.py:187-211) ----------
+        moving = live & (state["ply"] >= 0)
+        sq = a % NUM_SQUARES
+        d = jnp.clip(a // NUM_SQUARES, 0, 3)
+        src = _frame_sq(sq, c)
+        dr = _frame_dir(d, c)
+        sx, sy = src // SIZE, src % SIZE
+        nx, ny = sx + DIRX[dr], sy + DIRY[dr]
+        onb = (nx >= 0) & (nx < SIZE) & (ny >= 0) & (ny < SIZE)
+        dst = jnp.clip(nx, 0, SIZE - 1) * SIZE + jnp.clip(ny, 0, SIZE - 1)
+
+        pid = jnp.take_along_axis(board, src[:, None], axis=1)[:, 0].astype(jnp.int32)
+        pid_safe = jnp.clip(pid, 0, 15)
+
+        # goal escape: mover removed, immediate win (host:191-194)
+        escape = moving & ~onb
+        # normal move, possibly capturing the enemy piece on dst
+        normal = moving & onb
+        victim = jnp.take_along_axis(board, dst[:, None], axis=1)[:, 0].astype(jnp.int32)
+        cap = normal & (victim >= 0)
+        victim_safe = jnp.clip(victim, 0, 15)
+        vkind = kind[rows, victim_safe].astype(jnp.int32)
+
+        # captures (host _capture:177-181): victim off board + counts--
+        removed = jnp.where(cap, victim_safe, jnp.where(escape, pid_safe, 16))
+        rem_valid = cap | escape
+        rem_idx = jnp.clip(removed, 0, 15)
+        pos = pos.at[rows, rem_idx].set(
+            jnp.where(rem_valid, jnp.int8(-1), pos[rows, rem_idx])
+        )
+        alive = alive.at[rows, rem_idx].set(
+            jnp.where(rem_valid, False, alive[rows, rem_idx])
+        )
+        rem_color = rem_idx // 8
+        rem_kind = kind[rows, rem_idx].astype(jnp.int32)
+        counts = counts.at[rows, rem_color, rem_kind].add(
+            jnp.where(rem_valid, jnp.int8(-1), jnp.int8(0))
+        )
+
+        # board updates: clear src (escape or normal), place pid at dst
+        board = board.at[rows, src].set(
+            jnp.where(moving, jnp.int8(-1), board[rows, src])
+        )
+        board = board.at[rows, dst].set(
+            jnp.where(normal, pid.astype(jnp.int8), board[rows, dst])
+        )
+        pos = pos.at[rows, pid_safe].set(
+            jnp.where(normal, dst.astype(jnp.int8), pos[rows, pid_safe])
+        )
+
+        # wins (host:193-204): escape -> mover; last enemy blue captured ->
+        # mover; last enemy red captured (fed) -> enemy wins
+        enemy = c ^ 1
+        wiped = cap & (counts[rows, enemy, vkind] == 0)
+        win = jnp.where(escape, c.astype(jnp.int8), win)
+        win = jnp.where(
+            wiped & (vkind == BLUE), c.astype(jnp.int8), win
+        )
+        win = jnp.where(
+            wiped & (vkind == RED), enemy.astype(jnp.int8), win
+        )
+
+        ply = state["ply"] + live.astype(jnp.int32)
+        win = jnp.where(live & (ply >= MAX_PLY) & (win == -1), jnp.int8(2), win)
+
+        ended = win != -1
+        done = state["done"] | ended
+        next_c = (ply % 2).astype(jnp.int32)
+        active = (
+            jax.nn.one_hot(next_c, NUM_PLAYERS, dtype=bool)
+            & ~done[:, None]
+        )
+        return {
+            "board": board,
+            "pos": pos,
+            "kind": kind,
+            "alive": alive,
+            "counts": counts,
+            "ply": ply,
+            "win": win,
+            "active": active,
+            "done": done,
+        }
+
+    # -- legality -----------------------------------------------------------
+
+    @staticmethod
+    def legal_mask_all(state):
+        """(B, P, 214) bool.  The turn player's row is the true legal set
+        (host legal_actions, geister.py:270-284); the idle player's row is
+        all-True (sampled but never applied — the driver masks it out)."""
+        B = state["board"].shape[0]
+        rows = jnp.arange(B)
+        c = (state["ply"] % 2).astype(jnp.int32)
+        setting = state["ply"] < 0
+
+        # move legality for all 16 pieces x 4 dirs, masked to the turn color
+        pos = state["pos"].astype(jnp.int32)                 # (B, 16)
+        owner = jnp.arange(16)[None, :] // 8                 # (1, 16)
+        mine = state["alive"] & (owner == c[:, None])
+        px, py = pos // SIZE, pos % SIZE
+        nx = px[:, :, None] + DIRX[None, None, :]            # (B, 16, 4)
+        ny = py[:, :, None] + DIRY[None, None, :]
+        onb = (nx >= 0) & (nx < SIZE) & (ny >= 0) & (ny < SIZE)
+        dst = jnp.clip(nx, 0, SIZE - 1) * SIZE + jnp.clip(ny, 0, SIZE - 1)
+        dst_pid = state["board"][rows[:, None, None], dst].astype(jnp.int32)
+        ok_onb = onb & ((dst_pid < 0) | (dst_pid // 8 != c[:, None, None]))
+        # off-board: blues escaping through own goal squares
+        # (host _GOALS: Black exits at y=5, White at y=0, via x=-1 or x=6)
+        goal_y = jnp.where(c == 0, SIZE - 1, 0)[:, None, None]
+        off_goal = (~onb) & ((nx == -1) | (nx == SIZE)) & (ny == goal_y)
+        blue = state["kind"] == BLUE
+        ok_off = off_goal & blue[:, :, None]
+        valid = mine[:, :, None] & (ok_onb | ok_off)         # (B, 16, 4)
+
+        fsq = _frame_sq(pos, c[:, None])                     # (B, 16)
+        fdir = _frame_dir(jnp.arange(4)[None, None, :], c[:, None, None])
+        idx = fdir * NUM_SQUARES + fsq[:, :, None]           # (B, 16, 4)
+        idx = jnp.clip(idx, 0, NUM_MOVE_ACTIONS - 1)
+
+        move_mask = jnp.zeros((B, NUM_ACTIONS), bool)
+        move_mask = move_mask.at[rows[:, None, None], idx].max(valid)
+        set_mask = (
+            jnp.zeros((NUM_ACTIONS,), bool).at[NUM_MOVE_ACTIONS:].set(True)
+        )[None, :] & setting[:, None]
+        turn_row = jnp.where(setting[:, None], set_mask, move_mask)
+
+        mask = jnp.ones((B, NUM_PLAYERS, NUM_ACTIONS), bool)
+        return mask.at[rows, c].set(turn_row)
+
+    # -- observation --------------------------------------------------------
+
+    @staticmethod
+    def observe_mask(state):
+        """(B, P) — both players observe every step (the DRC hidden state
+        must advance for the idle player too, host generation with
+        ``observation: true``)."""
+        return jnp.broadcast_to((~state["done"])[:, None], state["active"].shape)
+
+    @staticmethod
+    def observation(state):
+        """{'scalar': (B, P, 18), 'board': (B, P, 7, 6, 6)} — per-player
+        views mirroring host observation() (geister.py:291-326): color bit,
+        my-view bit, 4x onehot4 piece counts; 7 planes with the opponent's
+        piece types hidden; White sees the board 180-degree rotated."""
+        B = state["board"].shape[0]
+        c = (state["ply"] % 2).astype(jnp.int32)
+        board = state["board"].astype(jnp.int32)             # (B, 36)
+        occupied = board >= 0
+        owner = jnp.where(occupied, board // 8, -1)          # (B, 36)
+        ptype = jnp.where(
+            occupied, state["kind"][jnp.arange(B)[:, None], jnp.clip(board, 0, 15)], -1
+        )
+        counts = state["counts"].astype(jnp.int32)           # (B, 2, 2)
+
+        def onehot4(n):  # (B,) -> (B, 4) for values 1..4
+            return (n[:, None] == jnp.arange(1, 5)[None, :]).astype(jnp.float32)
+
+        scalars, boards = [], []
+        for p in range(NUM_PLAYERS):
+            me, opp = p, 1 - p
+            my_view = (c == p).astype(jnp.float32)
+            scalar = jnp.concatenate(
+                [
+                    jnp.full((B, 1), 1.0 if me == 0 else 0.0),
+                    my_view[:, None],
+                    onehot4(counts[:, me, BLUE]),
+                    onehot4(counts[:, me, RED]),
+                    onehot4(counts[:, opp, BLUE]),
+                    onehot4(counts[:, opp, RED]),
+                ],
+                axis=1,
+            )
+            planes = jnp.stack(
+                [
+                    jnp.ones((B, NUM_SQUARES), jnp.float32),
+                    (owner == me).astype(jnp.float32),
+                    (owner == opp).astype(jnp.float32),
+                    ((owner == me) & (ptype == BLUE)).astype(jnp.float32),
+                    ((owner == me) & (ptype == RED)).astype(jnp.float32),
+                    jnp.zeros((B, NUM_SQUARES), jnp.float32),
+                    jnp.zeros((B, NUM_SQUARES), jnp.float32),
+                ],
+                axis=1,
+            )                                                # (B, 7, 36)
+            if p == 1:  # 180-degree rotation == reversed flat index
+                planes = planes[:, :, ::-1]
+            scalars.append(scalar)
+            boards.append(planes.reshape(B, 7, SIZE, SIZE))
+        return {
+            "scalar": jnp.stack(scalars, axis=1),
+            "board": jnp.stack(boards, axis=1),
+        }
+
+    # -- streaming-rollout hooks --------------------------------------------
+
+    @staticmethod
+    def record(state):
+        return {
+            "board": state["board"],
+            "kind": state["kind"],
+            "counts": state["counts"],
+            "ply": state["ply"],
+        }
+
+    @staticmethod
+    def outcome_scores(state):
+        """(B, P): +-1 for a win, zeros for a draw (host outcome(),
+        geister.py:256-261)."""
+        w = state["win"]
+        black = (w == 0).astype(jnp.float32) - (w == 1).astype(jnp.float32)
+        return jnp.stack([black, -black], axis=1)
+
+    @staticmethod
+    def episode_obs(compact, observing):
+        """Rebuild the {'scalar', 'board'} pytree (T, P, ...) from the
+        compact record, mirroring observation() in numpy."""
+        board = compact["board"].astype(np.int32)            # (T, 36)
+        kind = compact["kind"].astype(np.int32)              # (T, 16)
+        counts = compact["counts"].astype(np.int32)          # (T, 2, 2)
+        ply = compact["ply"].astype(np.int32)                # (T,)
+        T = board.shape[0]
+        c = ply % 2
+        occupied = board >= 0
+        owner = np.where(occupied, board // 8, -1)
+        ptype = np.where(
+            occupied, kind[np.arange(T)[:, None], np.clip(board, 0, 15)], -1
+        )
+
+        def onehot4(n):
+            return (n[:, None] == np.arange(1, 5)[None, :]).astype(np.float32)
+
+        scalars, boards = [], []
+        for p in range(NUM_PLAYERS):
+            me, opp = p, 1 - p
+            scalar = np.concatenate(
+                [
+                    np.full((T, 1), 1.0 if me == 0 else 0.0, np.float32),
+                    (c == p).astype(np.float32)[:, None],
+                    onehot4(counts[:, me, BLUE]),
+                    onehot4(counts[:, me, RED]),
+                    onehot4(counts[:, opp, BLUE]),
+                    onehot4(counts[:, opp, RED]),
+                ],
+                axis=1,
+            )
+            planes = np.stack(
+                [
+                    np.ones((T, NUM_SQUARES), np.float32),
+                    (owner == me).astype(np.float32),
+                    (owner == opp).astype(np.float32),
+                    ((owner == me) & (ptype == BLUE)).astype(np.float32),
+                    ((owner == me) & (ptype == RED)).astype(np.float32),
+                    np.zeros((T, NUM_SQUARES), np.float32),
+                    np.zeros((T, NUM_SQUARES), np.float32),
+                ],
+                axis=1,
+            )
+            if p == 1:
+                planes = planes[:, :, ::-1]
+            ob = observing[:, p, None]
+            scalars.append(scalar * ob)
+            boards.append(planes.reshape(T, 7, SIZE, SIZE) * ob[..., None, None])
+        return {
+            "scalar": np.stack(scalars, axis=1),
+            "board": np.stack(boards, axis=1),
+        }

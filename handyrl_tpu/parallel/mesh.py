@@ -18,10 +18,45 @@ collectives over ICI/DCN.
 from __future__ import annotations
 
 import math
+import threading
 from typing import Dict, Optional, Sequence
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Serializes the DISPATCH of multi-device (collective-bearing) programs.
+# Two SPMD programs enqueued concurrently from different host threads —
+# e.g. the sharded train step and the sharded device rollout — can reach
+# the devices in a different order on different devices; XLA's collective
+# rendezvous then waits for a participant that is queued behind the other
+# program and aborts the process ("Expected N threads to join ... only
+# N-1 arrived", reproduced on the 8-device CPU mesh).  Holding this lock
+# across the enqueue (the jitted call returns right after dispatch;
+# execution stays async) gives every device the same program order, which
+# is the documented requirement for concurrent collective programs.
+DISPATCH_LOCK = threading.Lock()
+
+
+def dispatch_serialized(call):
+    """Run ``call`` (which enqueues one multi-device program and returns
+    its async outputs) under DISPATCH_LOCK.
+
+    On TPU the lock covers only the enqueue — hardware per-device queues
+    then preserve the program order and execution stays async.  On the
+    CPU backend the lock additionally holds until the outputs are READY:
+    virtual devices share one thunk pool, so a collective's rendezvous
+    waiters can pin every pool thread while another in-flight program
+    holds the slot the last participant needs — a liveness failure
+    (XLA aborts after its 40 s rendezvous timeout) reproduced on the
+    8-device CPU mesh whenever the sharded train step and the sharded
+    device rollout ran concurrently."""
+    import jax as _jax
+
+    with DISPATCH_LOCK:
+        out = call()
+        if _jax.default_backend() == "cpu":
+            _jax.block_until_ready(out)
+        return out
 
 
 def make_mesh(spec: Optional[Dict[str, int]] = None, devices: Optional[Sequence] = None) -> Mesh:

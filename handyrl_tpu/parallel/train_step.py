@@ -38,7 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..ops import compute_loss_from_outputs
 from ..utils import tree_map
-from .mesh import batch_sharding, param_shardings, replicated_sharding
+from .mesh import batch_sharding, dispatch_serialized, param_shardings, replicated_sharding
 
 
 def _flat_apply(module, params, obs, lead_shape):
@@ -347,7 +347,11 @@ class TrainContext:
         return self._put_sharded(batch, self._batch_shard, batch["action"].shape[0])
 
     def train_step(self, state, device_batch, lr: float):
-        return self._bind(state)(state, device_batch, jnp.float32(lr))
+        # concurrent multi-device programs (e.g. the sharded device
+        # rollout) must reach every device in one order — see
+        # mesh.dispatch_serialized
+        fn = self._bind(state)
+        return dispatch_serialized(lambda: fn(state, device_batch, jnp.float32(lr)))
 
     def put_batches(self, host_batches):
         """Stack k host batches -> one (k, B, ...) device tree, B sharded
@@ -383,7 +387,9 @@ class TrainContext:
                 in_shardings=(ss, stacked_shard, self._replicated),
                 out_shardings=(ss, self._replicated),
             )
-        return self._train_steps(state, stacked_device_batch, jnp.float32(lr))
+        return dispatch_serialized(
+            lambda: self._train_steps(state, stacked_device_batch, jnp.float32(lr))
+        )
 
     def flops_per_step(self, state, device_batch):
         """HLO cost-analysis flops of one update (for MFU accounting); the

@@ -178,18 +178,56 @@ def build_streaming_fn(venv, module, n_lanes: int, k_steps: int, mesh=None):
 
     With ``mesh``, lanes shard over the mesh's 'dp' axis (params
     replicated): one SPMD program steps n_lanes games across all devices,
-    the self-play analogue of the data-parallel train step."""
+    the self-play analogue of the data-parallel train step.
 
-    def fn(params, state, key):
-        def body(state, key_t):
+    Works for simultaneous-move envs (every active player acts, e.g.
+    VectorHungryGeese) and strict-alternation envs (``state['active']``
+    one-hots the turn player, e.g. VectorGeister); recurrent modules
+    (DRC ConvLSTM) carry per-(lane, player) hidden state across steps,
+    zeroed on lane reset and committed where the player observed —
+    matching the host generator's per-player hidden handling."""
+
+    P = venv.num_players
+
+    def fn(params, state, hidden, key):
+        def body(carry, key_t):
+            state, hidden = carry
             kr, ka, kf = jax.random.split(key_t, 3)
             reset = state["done"]
             state = venv.reset_done(state, kr)
+            if hidden is not None:
+                # fresh games start from zero hidden (host: init_hidden)
+                hidden = tree_map(
+                    lambda h: h * ~reset.reshape((-1,) + (1,) * (h.ndim - 1)),
+                    hidden,
+                )
             active = state["active"]                     # (B, P) acting mask
-            obs = venv.observation(state)                # (B, P, ...)
-            B, P = active.shape
-            flat = obs.reshape((B * P,) + obs.shape[2:])
-            out = module.apply({"params": params}, flat, None)
+            observing = (
+                venv.observe_mask(state)
+                if hasattr(venv, "observe_mask")
+                else active
+            )
+            obs = venv.observation(state)                # leaves (B, P, ...)
+            B = active.shape[0]
+            flat = tree_map(lambda x: x.reshape((B * P,) + x.shape[2:]), obs)
+            h_flat = (
+                None
+                if hidden is None
+                else tree_map(lambda h: h.reshape((B * P,) + h.shape[2:]), hidden)
+            )
+            out = module.apply({"params": params}, flat, h_flat)
+            if hidden is not None:
+                new_hidden = tree_map(
+                    lambda h: h.reshape((B, P) + h.shape[1:]), out["hidden"]
+                )
+                # commit where observed, keep elsewhere (train_step.py:146)
+                hidden = jax.tree.map(
+                    lambda h, nh: jnp.where(
+                        observing.reshape((B, P) + (1,) * (h.ndim - 2)), nh, h
+                    ),
+                    hidden,
+                    new_hidden,
+                )
             logits = out["policy"].astype(jnp.float32).reshape(B, P, -1)
             legal = venv.legal_mask_all(state)           # (B, P, A) bool
             masked = jnp.where(legal, logits, logits - ILLEGAL)
@@ -204,8 +242,8 @@ def build_streaming_fn(venv, module, n_lanes: int, k_steps: int, mesh=None):
                 else jnp.zeros_like(prob)
             )
             record = {
-                "reset": reset,
                 "active": active,
+                "observing": observing,
                 "legal": legal,
                 "action": action.astype(jnp.int32),
                 "prob": prob,
@@ -215,12 +253,15 @@ def build_streaming_fn(venv, module, n_lanes: int, k_steps: int, mesh=None):
             state = venv.step(state, action, kf)
             record["done"] = state["done"]   # reset_done cleared stale flags
             record["outcome"] = venv.outcome_scores(state)  # final where done
-            return state, record
+            return (state, hidden), record
 
-        return jax.lax.scan(body, state, jax.random.split(key, k_steps))
+        (state, hidden), records = jax.lax.scan(
+            body, (state, hidden), jax.random.split(key, k_steps)
+        )
+        return state, hidden, records
 
     if mesh is None:
-        return jax.jit(fn, donate_argnums=(1,))
+        return jax.jit(fn, donate_argnums=(1, 2))
     from jax.sharding import NamedSharding, PartitionSpec
 
     lanes = NamedSharding(mesh, PartitionSpec("dp"))            # state: (B, ...)
@@ -228,9 +269,9 @@ def build_streaming_fn(venv, module, n_lanes: int, k_steps: int, mesh=None):
     rep = NamedSharding(mesh, PartitionSpec())
     return jax.jit(
         fn,
-        donate_argnums=(1,),
-        in_shardings=(rep, lanes, rep),
-        out_shardings=(lanes, rec),
+        donate_argnums=(1, 2),
+        in_shardings=(rep, lanes, lanes, rep),
+        out_shardings=(lanes, lanes, rec),
     )
 
 
@@ -256,22 +297,24 @@ def _streaming_episode(venv, steps: List[tuple], done_rec, done_k: int, lane: in
     action = gather("action", np.int32)    # (T, P)
     prob = gather("prob", np.float32)
     value = gather("value", np.float32)
-    active = gather("active", np.float32)  # (T, P) 0/1
+    active = gather("active", np.float32)  # (T, P) 0/1 — acted this step
+    observing = gather("observing", np.float32)      # (T, P) 0/1
     legal = gather("legal")                # (T, P, A) bool
     compact = {
         name: gather(name)
         for name in steps[0][0]
-        if name not in ("reset", "active", "legal", "action", "prob", "value",
-                        "done", "outcome")
+        if name not in ("active", "observing", "legal", "action",
+                        "prob", "value", "done", "outcome")
     }
-    obs = venv.episode_obs(compact, active)          # (T, P, ...)
+    obs = venv.episode_obs(compact, observing)       # (T, P, ...)
 
     final = np.asarray(done_rec["outcome"][done_k][b], np.float32)
     players = list(range(P))
     outcome = {p: float(final[p]) for p in players}
 
     # per-step reward (constant-per-step envs, e.g. Geister's -0.01) and
-    # its discounted return-to-go (generation.py:78-82)
+    # its discounted return-to-go (generation.py:78-82, 101-103 — rewards
+    # accrue to every player each step)
     step_reward = float(getattr(venv, "step_reward", 0.0))
     reward = np.full((T, P), step_reward, np.float32)
     ret = np.zeros((T, P), np.float32)
@@ -285,8 +328,8 @@ def _streaming_episode(venv, steps: List[tuple], done_rec, done_k: int, lane: in
     blocks = []
     for lo in range(0, T, block_len):
         hi = min(lo + block_len, T)
-        t = hi - lo
         act = active[lo:hi]
+        obsv = observing[lo:hi]
         amask = np.where(
             legal[lo:hi] & (act[..., None] > 0), 0.0, ILLEGAL
         ).astype(np.float32)
@@ -295,11 +338,11 @@ def _streaming_episode(venv, steps: List[tuple], done_rec, done_k: int, lane: in
             "prob": np.where(act > 0, prob[lo:hi], 1.0).astype(np.float32),
             "action": (action[lo:hi] * (act > 0)).astype(np.int32),
             "amask": amask,
-            "value": (value[lo:hi] * act).astype(np.float32),
-            "reward": reward[lo:hi] * act,
+            "value": (value[lo:hi] * obsv).astype(np.float32),
+            "reward": reward[lo:hi],
             "ret": ret[lo:hi],
             "tmask": act.astype(np.float32),
-            "omask": act.astype(np.float32),
+            "omask": obsv.astype(np.float32),
             "turn": np.argmax(act, axis=1).astype(np.int32),
         }
         blocks.append(compress_block(cols))
@@ -314,11 +357,12 @@ def _streaming_episode(venv, steps: List[tuple], done_rec, done_k: int, lane: in
 
 
 def make_device_rollout(venv, module, args: Dict[str, Any], n_games: int, mesh=None):
-    """Pick the rollout driver for a vector env: episodic single-call
-    games for strict-alternation envs (VectorTicTacToe), persistent
-    streaming lanes for simultaneous-move envs (VectorHungryGeese) —
-    lanes sharded over the mesh's 'dp' axis when a mesh is given."""
-    if getattr(venv, "simultaneous", False):
+    """Pick the rollout driver for a vector env: persistent streaming
+    lanes for envs exposing the streaming hooks (VectorHungryGeese,
+    VectorParallelTicTacToe, VectorGeister) — lanes sharded over the
+    mesh's 'dp' axis when a mesh is given — else episodic whole-horizon
+    calls (VectorTicTacToe's 9-ply games)."""
+    if hasattr(venv, "record"):
         return StreamingDeviceRollout(venv, module, args, n_lanes=n_games, mesh=mesh)
     return DeviceRollout(venv, module, args, n_games)
 
@@ -348,11 +392,13 @@ class StreamingDeviceRollout:
         self.args = args
         self.n_lanes = n_lanes
         self.k_steps = k_steps
+        self.module = module
         self._fn = build_streaming_fn(venv, module, n_lanes, k_steps, mesh)
         self._state = None
+        self._hidden = None
         self._pending = None         # in-flight device record (one-call pipeline)
         self._partial: List[List[tuple]] = [[] for _ in range(n_lanes)]
-        self.game_steps = 0          # lifetime game-steps (>=1 goose acting)
+        self.game_steps = 0          # lifetime game-steps (>=1 player acting)
         self.player_steps = 0        # lifetime per-player acting steps
 
     def generate(self, params, key) -> List[Dict[str, Any]]:
@@ -366,7 +412,17 @@ class StreamingDeviceRollout:
         if self._state is None:
             key, k0 = _jax.random.split(key)
             self._state = self.venv.init(self.n_lanes, k0)
-        self._state, record = self._fn(params, self._state, key)  # async
+            self._hidden = self.module.initial_state(
+                (self.n_lanes, self.venv.num_players)
+            )
+        from ..parallel.mesh import dispatch_serialized
+
+        # consistent cross-device program order vs the concurrent train
+        # step (and full serialization on the CPU backend) — the dispatch
+        # is async on TPU, so execution still overlaps the assembly below
+        self._state, self._hidden, record = dispatch_serialized(
+            lambda: self._fn(params, self._state, self._hidden, key)
+        )
         record, self._pending = self._pending, record
         if record is None:
             return []
@@ -402,3 +458,14 @@ class StreamingDeviceRollout:
             if seg < K:
                 self._partial[b].append((record, seg, K))
         return episodes
+
+    def drain(self) -> None:
+        """Block on the in-flight device block.  MUST be called before the
+        owning process exits: tearing down the runtime while an async
+        dispatch is still executing cancels XLA's worker threads mid-thunk
+        and aborts the process (observed as 'FATAL: exception not
+        rethrown' at interpreter exit)."""
+        import jax as _jax
+
+        if self._pending is not None:
+            _jax.block_until_ready(self._pending)

@@ -121,6 +121,13 @@ class Learner:
                     f"{args['env_args'].get('env')} exposes no vector_env()"
                 )
             self._venv = vector_env()
+            if self.args["observation"] and not hasattr(self._venv, "observe_mask"):
+                raise ValueError(
+                    "device_rollout_games with observation: true requires a "
+                    "vector env that records observer views (an observe_mask "
+                    f"hook); {type(self._venv).__name__ if not isinstance(self._venv, type) else self._venv.__name__} "
+                    "records acting players only — use host actors instead"
+                )
             # constructed HERE so misconfiguration (e.g. lane count not
             # divisible by the mesh's dp axis) fails the run at startup
             # instead of silently killing the rollout daemon thread
@@ -349,6 +356,18 @@ class Learner:
 
         roll = self._device_roll
         key = jax.random.PRNGKey(self.args["seed"] + 0x5EED)
+        try:
+            self._device_rollout_inner(roll, key)
+        finally:
+            # await the in-flight async dispatch; exiting the process with
+            # an XLA execution still running aborts it (see
+            # StreamingDeviceRollout.drain)
+            if hasattr(roll, "drain"):
+                roll.drain()
+
+    def _device_rollout_inner(self, roll, key) -> None:
+        import jax
+
         while not self.shutdown_flag:
             if self.num_returned_episodes >= self._next_update_episodes:
                 time.sleep(0.02)
@@ -381,9 +400,18 @@ class Learner:
         self._trainer_thread.start()
         self.worker.run()
         self._active_workers = len(getattr(self.worker, "threads", [])) or self.args["worker"]["num_parallel"]
+        rollout_thread = None
         if self._device_games > 0:
-            threading.Thread(target=self._device_rollout_loop, daemon=True).start()
+            rollout_thread = threading.Thread(
+                target=self._device_rollout_loop, daemon=True
+            )
+            rollout_thread.start()
         self.server()
+        if rollout_thread is not None:
+            # let an in-flight device call drain: tearing down the
+            # interpreter while a daemon thread is inside an XLA execute
+            # aborts the process (C++ exception at exit)
+            rollout_thread.join(timeout=120)
 
 
 def train_main(args: Dict[str, Any]) -> None:

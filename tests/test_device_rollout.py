@@ -395,6 +395,138 @@ class TestStreamingRollout:
         assert max(ep["steps"] for ep in episodes) > 4
 
 
+class TestVectorGeisterParity:
+    """VectorGeister vs the canonical host rules, lock-step: placement,
+    frame-rotated move decoding, captures + win conditions, 200-ply draw,
+    legal masks, and per-player observations must all match."""
+
+    def test_lockstep_random_legal(self):
+        from handyrl_tpu.envs.geister import Environment
+        from handyrl_tpu.envs.vector_geister import VectorGeister as V
+
+        B = 6
+        key = jax.random.PRNGKey(3)
+        state = V.init(B, key)
+        step = jax.jit(V.step)
+        legal_fn = jax.jit(V.legal_mask_all)
+        obs_fn = jax.jit(V.observation)
+        hosts = [Environment() for _ in range(B)]
+        for h in hosts:
+            h.reset()
+
+        finished = 0
+        for t in range(120):
+            lm = np.asarray(legal_fn(state))             # (B, P, 214)
+            obs = jax.device_get(obs_fn(state)) if t % 7 == 0 else None
+            prev_done = np.asarray(state["done"]).copy()
+            ply = np.asarray(state["ply"])
+            acts = np.zeros((B, 2), np.int32)
+            for b, h in enumerate(hosts):
+                if prev_done[b]:
+                    continue
+                c = ply[b] % 2
+                assert ply[b] == h.ply and c == h.turn(), (t, b)
+                # legal-mask parity with the host
+                dev_legal = set(np.flatnonzero(lm[b, c]).tolist())
+                assert dev_legal == set(h.legal_actions()), (t, b, ply[b])
+                # observation parity for both players (every 7th ply)
+                for p in range(2) if obs is not None else ():
+                    host_obs = h.observation(p)
+                    np.testing.assert_allclose(
+                        obs["scalar"][b, p], host_obs["scalar"], atol=1e-6
+                    )
+                    np.testing.assert_allclose(
+                        obs["board"][b, p], host_obs["board"], atol=1e-6
+                    )
+                acts[b, c] = np.random.RandomState(1000 * t + b).choice(
+                    sorted(dev_legal)
+                )
+            key, ks = jax.random.split(key)
+            state = step(state, jnp.asarray(acts), ks)
+            for b, h in enumerate(hosts):
+                if prev_done[b]:
+                    continue
+                c = ply[b] % 2
+                h.play(int(acts[b, c]))
+                # full state parity after the ply
+                assert (np.asarray(state["board"])[b].reshape(6, 6)
+                        == h.board).all(), (t, b)
+                win = int(np.asarray(state["win"])[b])
+                host_win = -1 if h.win_color is None else h.win_color
+                assert win == host_win, (t, b, win, host_win)
+                assert bool(np.asarray(state["done"])[b]) == h.terminal()
+                if h.terminal():
+                    finished += 1
+        assert finished >= 1  # random games regularly end within 80 plies
+
+    def test_streaming_episodes_and_training(self):
+        """Streaming rollout with the recurrent DRC net: episodes appear
+        (the near-deterministic init net shuffle-loops to the 200-ply
+        draw), carry the turn-alternating masks and pytree observations,
+        and train through the RNN burn-in path."""
+        from handyrl_tpu.envs.vector_geister import VectorGeister
+        from handyrl_tpu.parallel import TrainContext, make_mesh
+        from handyrl_tpu.runtime.batch import make_batch
+        from handyrl_tpu.runtime.device_rollout import StreamingDeviceRollout
+
+        env = make_env({"env": "Geister"})
+        module = env.net()
+        variables = init_variables(module, env)
+        cfg = normalize_args({
+            "env_args": {"env": "Geister"},
+            "train_args": {"batch_size": 8, "forward_steps": 8,
+                           "burn_in_steps": 4, "observation": True},
+        })
+        args = dict(cfg["train_args"])
+        args["env"] = cfg["env_args"]
+        roll = StreamingDeviceRollout(
+            VectorGeister, module, args, n_lanes=8, k_steps=32
+        )
+        key = jax.random.PRNGKey(0)
+        episodes = []
+        for _ in range(8):
+            key, sub = jax.random.split(key)
+            episodes += roll.generate(variables["params"], sub)
+        assert episodes, "no Geister episode finished in 224 plies"
+
+        ep = episodes[0]
+        cols = [decompress_block(b) for b in ep["blocks"]]
+        scalar = np.concatenate([c["obs"]["scalar"] for c in cols])
+        board = np.concatenate([c["obs"]["board"] for c in cols])
+        tmask = np.concatenate([c["tmask"] for c in cols])
+        omask = np.concatenate([c["omask"] for c in cols])
+        amask = np.concatenate([c["amask"] for c in cols])
+        reward = np.concatenate([c["reward"] for c in cols])
+        T = ep["steps"]
+        assert scalar.shape == (T, 2, 18) and board.shape == (T, 2, 7, 6, 6)
+        # strict alternation: exactly one actor per step, Black first
+        assert (tmask.sum(axis=1) == 1.0).all()
+        assert (tmask[:, 0] == (np.arange(T) % 2 == 0)).all()
+        # both players observe every step (DRC hidden advances for both)
+        assert (omask == 1.0).all()
+        # placement plies offer exactly the 70 layouts
+        assert (amask[0, 0] == 0).sum() == 70 and (amask[1, 1] == 0).sum() == 70
+        # per-step reward for both players (host reward(), geister.py:253-254)
+        np.testing.assert_allclose(reward, -0.01 * np.ones((T, 2)), atol=1e-7)
+        assert ep["outcome"][0] == -ep["outcome"][1]
+
+        store = EpisodeStore(64)
+        store.extend(episodes)
+        windows = []
+        while len(windows) < args["batch_size"]:
+            w = store.sample_window(
+                args["forward_steps"], args["burn_in_steps"], args["compress_steps"]
+            )
+            if w is not None:
+                windows.append(w)
+        batch = make_batch(windows, args)
+        ctx = TrainContext(module, args, make_mesh({"dp": -1}))
+        tstate = ctx.init_state(variables["params"])
+        tstate, metrics = ctx.train_step(tstate, ctx.put_batch(batch), 1e-4)
+        m = jax.device_get(metrics)
+        assert np.isfinite(m["total"]) and m["dcnt"] > 0
+
+
 class TestVectorParallelTicTacToe:
     """Streaming rollout on the simultaneous-move TicTacToe variant:
     device games must replay exactly through the host rules."""

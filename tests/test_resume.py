@@ -77,7 +77,10 @@ def test_learner_resume_continues_steps(tmp_path, monkeypatch):
     steps_before = learner.trainer.steps
     assert steps_before > 0
 
-    resumed = Learner(_tiny_args({"restart_epoch": 1, "epochs": 2}))
+    # three more epochs: on a loaded 1-core host the first resumed epoch
+    # can complete before the train step finishes recompiling (zero new
+    # steps), which is legitimate learner behavior, not a resume bug
+    resumed = Learner(_tiny_args({"restart_epoch": 1, "epochs": 4}))
     # the trainer may step a little past the last checkpoint before stopping,
     # so the restored count is positive and at most what we observed live
     assert 0 < resumed.trainer.steps <= steps_before
