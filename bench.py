@@ -57,7 +57,7 @@ def _note(msg: str) -> None:
 _T0 = time.perf_counter()
 
 
-def _probe_accelerator(timeout: float = 150.0) -> Optional[str]:
+def _probe_accelerator(timeout: float = 120.0) -> Optional[str]:
     """Try accelerator backend init in a SUBPROCESS (it can hang, not just
     raise — e.g. a stale chip lease after a killed process); returns None
     if healthy, else an error string."""
@@ -81,7 +81,9 @@ def _probe_accelerator(timeout: float = 150.0) -> Optional[str]:
 def _devices_with_retry(retries: int = 3, delay: float = 20.0):
     """Probe the accelerator out-of-process with retries; fall back to CPU
     so the bench always produces a measured number (round-1 failure mode:
-    one transient axon UNAVAILABLE crashed the whole bench)."""
+    one transient axon UNAVAILABLE crashed the whole bench).  A HUNG
+    probe (wedged chip lease — recovers in tens of minutes, not seconds)
+    is not retried: better to spend the budget measuring on CPU."""
     import jax
 
     err = None
@@ -92,6 +94,8 @@ def _devices_with_retry(retries: int = 3, delay: float = 20.0):
                 return jax.devices(), None
             except Exception as exc:  # probe ok but in-process init failed
                 err = str(exc)
+        if "hung" in (err or ""):
+            break
         _note(f"accelerator probe failed ({err}); retrying")
         if attempt + 1 < retries:
             time.sleep(delay)
@@ -418,9 +422,9 @@ def _streaming_selfplay_bench(env_name: str, overrides, duration: float,
     while time.perf_counter() - t0 < duration:
         key, sub = jax.random.split(key)
         n_eps += len(roll.generate(params, sub))
-    roll.drain()  # the overlap leaves one block in flight; exiting with it
-    dt = time.perf_counter() - t0  # running aborts the process at teardown
-    return {
+    dt = time.perf_counter() - t0  # before drain: the drained block's steps
+    roll.drain()                   # are never counted, so its runtime must
+    return {                       # not land in the denominator either
         "env_steps_per_sec": (roll.game_steps - steps0) / dt,
         "player_steps_per_sec": (roll.player_steps - psteps0) / dt,
         "episodes_per_sec": n_eps / dt,

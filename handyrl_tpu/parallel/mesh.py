@@ -50,12 +50,10 @@ def dispatch_serialized(call):
     (XLA aborts after its 40 s rendezvous timeout) reproduced on the
     8-device CPU mesh whenever the sharded train step and the sharded
     device rollout ran concurrently."""
-    import jax as _jax
-
     with DISPATCH_LOCK:
         out = call()
-        if _jax.default_backend() == "cpu":
-            _jax.block_until_ready(out)
+        if jax.default_backend() == "cpu":
+            jax.block_until_ready(out)
         return out
 
 

@@ -394,13 +394,29 @@ class TrainContext:
     def flops_per_step(self, state, device_batch):
         """HLO cost-analysis flops of one update (for MFU accounting); the
         lowering shares the bound executable's signature, so it does not
-        install a second entry in the jit cache."""
-        try:
-            ca = self._bind(state).lower(
-                state, device_batch, jnp.float32(1e-5)
-            ).cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0] if ca else {}
-            return float(ca.get("flops", 0.0)) or None
-        except Exception:
-            return None
+        install a second entry in the jit cache.  Some PJRT clients (e.g.
+        tunneled TPU plugins) return no cost model — fall back to a
+        CPU-backend lowering of the same program, whose flop count is the
+        same arithmetic."""
+        def _cpu_lowering():
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                return jax.jit(self._step_fn).lower(
+                    jax.tree.map(jax.typeof, state),
+                    jax.tree.map(jax.typeof, device_batch),
+                    jax.ShapeDtypeStruct((), jnp.float32),
+                )
+
+        for lower in (
+            lambda: self._bind(state).lower(state, device_batch, jnp.float32(1e-5)),
+            _cpu_lowering,
+        ):
+            try:
+                ca = lower().cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else {}
+                flops = float(ca.get("flops", 0.0))
+                if flops > 0:
+                    return flops
+            except Exception:
+                continue
+        return None
