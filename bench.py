@@ -72,9 +72,9 @@ def _probe_accelerator(timeout: float = 120.0) -> Optional[str]:
             text=True,
         )
     except subprocess.TimeoutExpired:
-        return f"accelerator backend init hung >{timeout:.0f}s"
+        return ("hung", f"accelerator backend init hung >{timeout:.0f}s")
     if proc.returncode != 0:
-        return "accelerator backend init failed: " + (proc.stderr or "")[-300:]
+        return ("failed", "accelerator backend init failed: " + (proc.stderr or "")[-300:])
     return None
 
 
@@ -87,21 +87,28 @@ def _devices_with_retry(retries: int = 3, delay: float = 20.0):
     import jax
 
     err = None
+    tried = 0
     for attempt in range(retries):
-        err = _probe_accelerator()
-        if err is None:
+        tried = attempt + 1
+        probe = _probe_accelerator()
+        if probe is None:
             try:
                 return jax.devices(), None
             except Exception as exc:  # probe ok but in-process init failed
                 err = str(exc)
-        if "hung" in (err or ""):
-            break
+                _note(f"accelerator probe failed ({err}); retrying")
+                if attempt + 1 < retries:
+                    time.sleep(delay)
+                continue
+        kind, err = probe
+        if kind == "hung":
+            break  # wedged lease clears in tens of minutes; don't burn budget
         _note(f"accelerator probe failed ({err}); retrying")
         if attempt + 1 < retries:
             time.sleep(delay)
     try:
         jax.config.update("jax_platforms", "cpu")
-        return jax.devices(), f"accelerator unavailable after {retries} tries ({err}); CPU fallback"
+        return jax.devices(), f"accelerator unavailable after {tried} tries ({err}); CPU fallback"
     except Exception as exc2:
         return None, f"no backend at all: {err} / {exc2}"
 

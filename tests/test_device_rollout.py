@@ -621,6 +621,27 @@ class TestVectorParallelTicTacToe:
         assert 0.35 < by[0] / total < 0.65
 
 
+def test_learner_rejects_observer_training_without_observer_views(tmp_path, monkeypatch):
+    """observation: true + device rollouts must fail at startup for vector
+    envs that record acting players only (HungryGeese) — and be accepted
+    for ones with an observe_mask hook (Geister, covered by the CLI run)."""
+    import pytest
+
+    from handyrl_tpu.runtime.learner import Learner
+
+    monkeypatch.chdir(tmp_path)
+    args = normalize_args({
+        "env_args": {"env": "HungryGeese"},
+        "train_args": {
+            "batch_size": 8, "forward_steps": 4, "observation": True,
+            "turn_based_training": False, "device_rollout_games": 16,
+            "worker": {"num_parallel": 1},
+        },
+    })
+    with pytest.raises(ValueError, match="observer views"):
+        Learner(args)
+
+
 def test_learner_with_device_rollouts(tmp_path, monkeypatch):
     """Full learner stack with on-device generation: device batches feed
     the store and drive the epoch cadence; host workers keep evaluating."""
