@@ -10,7 +10,16 @@ kernels):
    driving the batched cross-env inference engine (the actor-plane TPU
    path); reference single-process generation measured 1,557 env-steps/s.
 3. HungryGeese training throughput + input_wait_frac through the threaded
-   BatchPipeline, plus MFU from XLA compiled cost analysis.
+   BatchPipeline, plus MFU from XLA compiled cost analysis (always
+   reported — as a number or as null with the reason).
+4. The north-star loop itself: streaming on-device HungryGeese self-play
+   feeding the store while the learner trains from it concurrently, with
+   both planes' rates, learner input starvation, and the per-chip
+   fraction of the 100k/v4-32 target.
+
+Every timed window stretches until at least one unit (update / episode)
+completes — a slow backend yields a small measured rate or an explicit
+null+note, never a silent 0.0.
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"} plus
 "extra" with the geese numbers.  Never exits non-zero for backend trouble:
@@ -57,10 +66,10 @@ def _note(msg: str) -> None:
 _T0 = time.perf_counter()
 
 
-def _probe_accelerator(timeout: float = 120.0) -> Optional[str]:
+def _probe_accelerator(timeout: float = 120.0) -> Optional[tuple]:
     """Try accelerator backend init in a SUBPROCESS (it can hang, not just
     raise — e.g. a stale chip lease after a killed process); returns None
-    if healthy, else an error string."""
+    if healthy, else a ("hung" | "failed", message) tuple."""
     import subprocess
     import sys
 
@@ -85,6 +94,11 @@ def _devices_with_retry(retries: int = 3, delay: float = 20.0):
     probe (wedged chip lease — recovers in tens of minutes, not seconds)
     is not retried: better to spend the budget measuring on CPU."""
     import jax
+
+    if os.environ.get("HANDYRL_PLATFORM") == "cpu":
+        # explicit CPU request (validation runs; same contract as main.py)
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices(), None
 
     err = None
     tried = 0
@@ -172,17 +186,32 @@ def _timed_loop(step, duration: float) -> float:
     """Warm-compile then time: ``step()`` dispatches (possibly async)
     device work and returns a value to block on; the trailing
     block_until_ready is inside the measured window so enqueued work is
-    fully accounted.  Returns calls/sec."""
+    fully accounted.  Returns calls/sec (always from >= 1 completed call:
+    the window stretches rather than reporting a zero)."""
     import jax
 
     jax.block_until_ready(step())  # compile + warm
     t0 = time.perf_counter()
     n = 0
-    while time.perf_counter() - t0 < duration:
+    while time.perf_counter() - t0 < duration or n == 0:
         out = step()
         n += 1
+        if n == 1:
+            jax.block_until_ready(out)  # slow-backend case: 1 call > window
     jax.block_until_ready(out)
     return n / (time.perf_counter() - t0)
+
+
+def _sig(x, digits: int = 3):
+    """Round a rate to ``digits`` significant figures — never collapses a
+    small-but-measured value to 0.0 the way fixed-decimal rounding did
+    (round 2 reported geister_rnn_updates_per_sec: 0.0 for a measured
+    0.0021/s)."""
+    if x is None or x == 0:
+        return x
+    from math import floor, log10
+
+    return round(x, max(digits - 1 - floor(log10(abs(x))), 0))
 
 
 def _train_bench(env_name: str, overrides, duration: float, n_devices: int,
@@ -216,6 +245,25 @@ def _train_bench(env_name: str, overrides, duration: float, n_devices: int,
     flops = ctx.flops_per_step(state, device_batches[0])
 
     holder = {"state": state, "i": 0}
+
+    # FF compaction can give the staged batches distinct live-prefix
+    # shapes; warm-compile every DISTINCT shape outside the timed window
+    # (one cold compile inside the loop skews a 12 s window badly).  Only
+    # distinct ones: an extra no-op warm costs a full update, which on a
+    # slow backend (DRC on 1-core CPU: minutes) is far from free.
+    def _shape_key(b):
+        return tuple(
+            (x.shape, str(x.dtype)) for x in jax.tree.leaves(b["observation"])
+        )
+
+    seen = {_shape_key(device_batches[0])}
+    for b in device_batches[1:]:
+        k = _shape_key(b)
+        if k in seen:
+            continue
+        seen.add(k)
+        holder["state"], m = ctx.train_step(holder["state"], b, 1e-5)
+        jax.block_until_ready(m["total"])
 
     def seq_step():
         holder["state"], metrics = ctx.train_step(
@@ -327,28 +375,26 @@ def _generation_bench(env_name: str, overrides, duration: float, num_actors: int
     }
 
 
-def _pipeline_bench(train_res, duration: float):
-    """Train through the threaded BatchPipeline (replay -> make_batch ->
-    device_put -> step) and measure input starvation (north-star: learner
-    never input-starved)."""
+def _timed_pipeline_train(pipe, ctx, state, duration: float, on_timed_start=None):
+    """Warm the train path on one pipeline batch, then time updates fed by
+    the pipeline, accounting time spent waiting on input separately.
+    Stretches past ``duration`` until >= 1 update completes (never a
+    silent zero).  ``on_timed_start`` fires after the warm-up, right
+    before the clock starts (e.g. to launch a concurrent producer and
+    snapshot its counters in sync with the window).  Returns
+    (n_updates, wait_s, dt)."""
     import jax
-
-    from handyrl_tpu.runtime.trainer import BatchPipeline
-
-    args, ctx, store = train_res["args"], train_res["ctx"], train_res["store"]
-    stop = threading.Event()
-    pipe = BatchPipeline(args, store, ctx, stop)
-    pipe.start()
-    state = ctx.init_state(train_res["model"].variables["params"])
 
     batch = pipe.batch()
     state, metrics = ctx.train_step(state, batch, 1e-5)  # compile path warm
     jax.block_until_ready(metrics["total"])
 
+    if on_timed_start is not None:
+        on_timed_start()
     wait_s = 0.0
     n = 0
     t0 = time.perf_counter()
-    while time.perf_counter() - t0 < duration:
+    while time.perf_counter() - t0 < duration or n == 0:
         tw = time.perf_counter()
         batch = pipe.batch()
         wait_s += time.perf_counter() - tw
@@ -357,7 +403,21 @@ def _pipeline_bench(train_res, duration: float):
         state, metrics = ctx.train_step(state, batch, 1e-5)
         n += 1
     jax.block_until_ready(metrics["total"])
-    dt = time.perf_counter() - t0
+    return n, wait_s, time.perf_counter() - t0
+
+
+def _pipeline_bench(train_res, duration: float):
+    """Train through the threaded BatchPipeline (replay -> make_batch ->
+    device_put -> step) and measure input starvation (north-star: learner
+    never input-starved)."""
+    from handyrl_tpu.runtime.trainer import BatchPipeline
+
+    args, ctx, store = train_res["args"], train_res["ctx"], train_res["store"]
+    stop = threading.Event()
+    pipe = BatchPipeline(args, store, ctx, stop)
+    pipe.start()
+    state = ctx.init_state(train_res["model"].variables["params"])
+    n, wait_s, dt = _timed_pipeline_train(pipe, ctx, state, duration)
     stop.set()
     return {
         "updates_per_sec": n / dt,
@@ -426,7 +486,13 @@ def _streaming_selfplay_bench(env_name: str, overrides, duration: float,
     steps0, psteps0 = roll.game_steps, roll.player_steps
     n_eps = 0
     t0 = time.perf_counter()
-    while time.perf_counter() - t0 < duration:
+    # adaptive window: stretch (up to 4x) until at least one episode has
+    # completed, so episodes/sec is a measurement, not a silent 0.0 on a
+    # slow backend; if even that fails, report null with the reason
+    while True:
+        dt = time.perf_counter() - t0
+        if dt >= duration and (n_eps > 0 or dt >= 4 * duration):
+            break
         key, sub = jax.random.split(key)
         n_eps += len(roll.generate(params, sub))
     dt = time.perf_counter() - t0  # before drain: the drained block's steps
@@ -434,10 +500,102 @@ def _streaming_selfplay_bench(env_name: str, overrides, duration: float,
     return {                       # not land in the denominator either
         "env_steps_per_sec": (roll.game_steps - steps0) / dt,
         "player_steps_per_sec": (roll.player_steps - psteps0) / dt,
-        "episodes_per_sec": n_eps / dt,
+        "episodes_per_sec": n_eps / dt if n_eps else None,
+        "episodes_note": None if n_eps else f"no episode completed in {dt:.0f}s window",
         "lanes": n_lanes,
         "k_steps": k_steps,
     }
+
+
+def _concurrent_northstar_bench(train_res, duration: float,
+                                n_lanes: int = 256, k_steps: int = 32):
+    """The north-star loop on ONE chip: streaming on-device self-play
+    FEEDING the replay store while the learner trains from it concurrently
+    — the architecture that replaces the reference's host worker tree
+    (worker.py:110-189).  Captures both planes' rates plus learner input
+    starvation; BASELINE.json's target is 100k env-steps/s on a v4-32
+    with the learner never starved, i.e. ~3,125 env-steps/s per chip."""
+    import jax
+
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.runtime import EpisodeStore
+    from handyrl_tpu.runtime.device_rollout import StreamingDeviceRollout
+    from handyrl_tpu.runtime.trainer import BatchPipeline
+
+    args, ctx, module = train_res["args"], train_res["ctx"], train_res["module"]
+    env = make_env(args["env"])
+    params = train_res["model"].variables["params"]
+    if jax.default_backend() != "tpu":
+        # fewer lanes so the ~200-step geese episodes start completing
+        # within the prefill budget on a slow backend
+        n_lanes = min(n_lanes, 32)
+    roll = StreamingDeviceRollout(
+        env.vector_env(), module, args, n_lanes=n_lanes, k_steps=k_steps,
+        mesh=ctx.mesh,
+    )
+    store = EpisodeStore(8192)
+    stop = threading.Event()
+    holder = {"key": jax.random.PRNGKey(1), "rollout_error": None}
+
+    def rollout_step():
+        holder["key"], sub = jax.random.split(holder["key"])
+        eps = roll.generate(params, sub)
+        if eps:
+            store.extend(eps)
+
+    def rollout_loop():
+        try:
+            while not stop.is_set():
+                rollout_step()
+        except Exception:
+            holder["rollout_error"] = traceback.format_exc(limit=3)
+        finally:
+            roll.drain()
+
+    # pre-fill OUTSIDE the timed window so the pipeline can sample at once
+    _note(f"northstar: prefilling store via streaming self-play ({n_lanes} lanes)")
+    t_fill = time.perf_counter()
+    while len(store) < 2 * n_lanes and time.perf_counter() - t_fill < 10 * duration:
+        rollout_step()
+    if len(store) == 0:
+        roll.drain()
+        return {
+            "skipped": (
+                f"no episode completed in the {time.perf_counter() - t_fill:.0f}s "
+                f"prefill budget ({n_lanes} lanes)"
+            )
+        }
+
+    pipe_stop = threading.Event()
+    pipe = BatchPipeline(args, store, ctx, pipe_stop)
+    pipe.start()
+    state = ctx.init_state(params)
+
+    _note(f"northstar: {len(store)} episodes staged; timing concurrent train+selfplay")
+    thread = threading.Thread(target=rollout_loop, daemon=True)
+    counters = {"steps0": 0}
+
+    def launch_producer():
+        counters["steps0"] = roll.game_steps
+        thread.start()
+
+    n, wait_s, dt = _timed_pipeline_train(
+        pipe, ctx, state, duration, on_timed_start=launch_producer
+    )
+    steps0 = counters["steps0"]
+    stop.set()
+    pipe_stop.set()
+    thread.join(timeout=120.0)
+    out = {
+        "trained_env_steps_per_sec": n * args["batch_size"] * args["forward_steps"] / dt,
+        "selfplay_env_steps_per_sec": (roll.game_steps - steps0) / dt,
+        "input_wait_frac": wait_s / dt,
+        "episodes_in_store": len(store),
+        "per_chip_northstar_frac": (roll.game_steps - steps0) / dt / 3125.0,
+    }
+    if holder["rollout_error"]:
+        out["rollout_error"] = holder["rollout_error"]
+    return out
 
 
 def _flash_attention_bench(duration: float = 3.0):
@@ -548,9 +706,11 @@ def main() -> None:
         result["extra"]["geese_device_selfplay_player_steps_per_sec"] = round(
             gd["player_steps_per_sec"], 1
         )
-        result["extra"]["geese_device_selfplay_episodes_per_sec"] = round(
-            gd["episodes_per_sec"], 2
+        result["extra"]["geese_device_selfplay_episodes_per_sec"] = _sig(
+            gd["episodes_per_sec"]
         )
+        if gd["episodes_note"]:
+            result["extra"]["geese_device_selfplay_episodes_note"] = gd["episodes_note"]
         result["extra"]["geese_device_selfplay_vs_reference_gen"] = round(
             gd["env_steps_per_sec"] / REFERENCE_GEN_STEPS_PER_SEC, 2
         )
@@ -574,22 +734,60 @@ def main() -> None:
     # 3. north-star learner plane: GeeseNet train + starvation + MFU
     try:
         gt = _train_bench("HungryGeese", geese_over, T_TRAIN, len(devices))
-        result["extra"]["geese_trained_env_steps_per_sec"] = round(
-            gt["trained_env_steps_per_sec"], 1
+        result["extra"]["geese_trained_env_steps_per_sec"] = _sig(
+            gt["trained_env_steps_per_sec"], 5
         )
-        result["extra"]["geese_updates_per_sec"] = round(gt["updates_per_sec"], 2)
+        result["extra"]["geese_updates_per_sec"] = _sig(gt["updates_per_sec"])
+        # MFU is ALWAYS reported — as a number, or as null plus the reason
+        # (round 2 silently omitted it when the peak-FLOPs lookup missed)
         peak = _peak_flops(devices[0])
-        if gt["flops_per_step"] and peak:
-            result["extra"]["geese_mfu"] = round(
-                gt["flops_per_step"] * gt["updates_per_sec"] / (peak * len(devices)), 4
-            )
+        if gt["flops_per_step"]:
             result["extra"]["geese_flops_per_step"] = gt["flops_per_step"]
+            if peak:
+                result["extra"]["geese_mfu"] = round(
+                    gt["flops_per_step"] * gt["updates_per_sec"] / (peak * len(devices)), 4
+                )
+            else:
+                result["extra"]["geese_mfu"] = None
+                result["extra"]["geese_mfu_note"] = (
+                    "no peak-FLOPs table entry for device kind "
+                    f"'{getattr(devices[0], 'device_kind', '?')}'"
+                )
+        else:
+            result["extra"]["geese_mfu"] = None
+            result["extra"]["geese_mfu_note"] = (
+                "XLA cost analysis returned no flops from either the native "
+                "or the CPU-backend lowering"
+            )
         pipe = _pipeline_bench(gt, T_TRAIN)
-        result["extra"]["geese_pipeline_updates_per_sec"] = round(pipe["updates_per_sec"], 2)
+        result["extra"]["geese_pipeline_updates_per_sec"] = _sig(pipe["updates_per_sec"])
         result["extra"]["geese_input_wait_frac"] = round(pipe["input_wait_frac"], 4)
     except Exception:
         gt = None
         result["error"] = (result["error"] or "") + " geese-train: " + traceback.format_exc(limit=3)
+
+    # 3c. the north-star loop itself: device self-play feeding training,
+    # concurrently, on the same chip (VERDICT r2 item 2)
+    try:
+        if gt is not None:
+            ns = _concurrent_northstar_bench(gt, T_TRAIN)
+            if "skipped" in ns:
+                result["extra"]["northstar_note"] = ns["skipped"]
+            else:
+                result["extra"]["northstar_concurrent_trained_env_steps_per_sec"] = _sig(
+                    ns["trained_env_steps_per_sec"], 5
+                )
+                result["extra"]["northstar_concurrent_selfplay_env_steps_per_sec"] = _sig(
+                    ns["selfplay_env_steps_per_sec"], 5
+                )
+                result["extra"]["northstar_input_wait_frac"] = round(ns["input_wait_frac"], 4)
+                result["extra"]["northstar_per_chip_frac"] = _sig(
+                    ns["per_chip_northstar_frac"]
+                )
+                if ns.get("rollout_error"):
+                    result["error"] = (result["error"] or "") + " northstar-rollout: " + ns["rollout_error"]
+    except Exception:
+        result["error"] = (result["error"] or "") + " northstar: " + traceback.format_exc(limit=3)
 
     # 3b. bf16 mixed precision (MXU-rate forward/backward, fp32 master
     # weights) on the same store — the compute_dtype knob's headroom
@@ -599,8 +797,8 @@ def main() -> None:
                 "HungryGeese", {**geese_over, "compute_dtype": "bfloat16"},
                 T_TRAIN, len(devices), reuse=gt,
             )
-            result["extra"]["geese_bf16_updates_per_sec"] = round(
-                gt16["updates_per_sec"], 2
+            result["extra"]["geese_bf16_updates_per_sec"] = _sig(
+                gt16["updates_per_sec"]
             )
     except Exception:
         result["error"] = (result["error"] or "") + " geese-bf16: " + traceback.format_exc(limit=3)
@@ -617,11 +815,11 @@ def main() -> None:
             len(devices),
             fill_episodes=12,  # 200-turn episodes; filling dominates otherwise
         )
-        result["extra"]["geister_rnn_updates_per_sec"] = round(
-            geister["updates_per_sec"], 2
+        result["extra"]["geister_rnn_updates_per_sec"] = _sig(
+            geister["updates_per_sec"]
         )
-        result["extra"]["geister_rnn_trained_env_steps_per_sec"] = round(
-            geister["trained_env_steps_per_sec"], 1
+        result["extra"]["geister_rnn_trained_env_steps_per_sec"] = _sig(
+            geister["trained_env_steps_per_sec"], 5
         )
     except Exception:
         result["error"] = (result["error"] or "") + " geister: " + traceback.format_exc(limit=3)
@@ -636,9 +834,11 @@ def main() -> None:
         result["extra"]["geister_device_selfplay_env_steps_per_sec"] = round(
             gsd["env_steps_per_sec"], 1
         )
-        result["extra"]["geister_device_selfplay_episodes_per_sec"] = round(
-            gsd["episodes_per_sec"], 2
+        result["extra"]["geister_device_selfplay_episodes_per_sec"] = _sig(
+            gsd["episodes_per_sec"]
         )
+        if gsd["episodes_note"]:
+            result["extra"]["geister_device_selfplay_episodes_note"] = gsd["episodes_note"]
     except Exception:
         result["error"] = (result["error"] or "") + " geister-device-selfplay: " + traceback.format_exc(limit=3)
 

@@ -1,7 +1,7 @@
 from .nets import SimpleConvNet, GeeseNet, GeisterNet
 from .transformer import TransformerNet
 from .inference import InferenceModel, RandomModel, init_variables
-from .export import ExportedModel, export_model
+from .export import ExportedModel, OnnxModel, export_model, export_onnx
 
 __all__ = [
     "SimpleConvNet",
@@ -12,5 +12,7 @@ __all__ = [
     "RandomModel",
     "init_variables",
     "ExportedModel",
+    "OnnxModel",
     "export_model",
+    "export_onnx",
 ]

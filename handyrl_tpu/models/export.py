@@ -112,23 +112,24 @@ class ExportedModel(_ArtifactModel):
         return jax.device_get(outputs)
 
 
-# -- TF SavedModel bridge (non-JAX runtimes) --------------------------------
+# -- TF SavedModel / ONNX bridge (non-JAX runtimes) -------------------------
 
-def export_savedmodel(module, variables, sample_obs, path: str) -> None:
-    """Freeze (module, variables) into a TF SavedModel via jax2tf.
+def _poly(x):
+    return "(" + ", ".join(["b"] + ["_"] * (np.asarray(x).ndim - 1)) + ")"
 
-    The bridge artifact for runtimes outside JAX — TF Serving, TFLite,
-    or ONNX via the standard tf2onnx converter where installed — covering
-    the deployment role of the reference's ONNX export
-    (scripts/make_onnx_model.py:28-58).  Naming parity with the reference
-    (``input.N``/``hidden.N`` discovered by prefix, evaluation.py:335-344):
-    observation pytree leaves flatten to ``input_N``, hidden-state leaves
-    to ``hidden_N`` (jax.tree order), outputs to their dict keys plus
-    ``hidden_N`` for the next-step state.  Batch dimension is polymorphic.
-    """
+
+def _tf_spec(x, name):
     import tensorflow as tf
-    from jax.experimental import jax2tf
 
+    x = np.asarray(x)
+    return tf.TensorSpec([None] + list(x.shape[1:]), x.dtype, name=name)
+
+
+def _bridge_fn(module, variables, sample_obs):
+    """Flat-leaf wrapper shared by the SavedModel and ONNX exporters:
+    observation leaves become ``input_N``, hidden leaves ``hidden_N``
+    (jax.tree order, the reference's name-prefix contract
+    evaluation.py:335-344); returns (fn, leaves, names, hidden0, n_obs)."""
     hidden0 = module.initial_state((1,))
     obs_b = tree_map(lambda x: np.asarray(x)[None], sample_obs)
     obs_leaves, obs_tree = jax.tree.flatten(obs_b)
@@ -147,24 +148,36 @@ def export_savedmodel(module, variables, sample_obs, path: str) -> None:
             flat[f"hidden_{i}"] = leaf
         return flat
 
-    def poly(x):
-        return "(" + ", ".join(["b"] + ["_"] * (np.asarray(x).ndim - 1)) + ")"
-
-    def tf_spec(x, name):
-        x = np.asarray(x)
-        return tf.TensorSpec([None] + list(x.shape[1:]), x.dtype, name=name)
-
     leaves = list(obs_leaves) + list(hid_leaves)
     names = [f"input_{i}" for i in range(len(obs_leaves))] + [
         f"hidden_{i}" for i in range(len(hid_leaves))
     ]
+    return fn, leaves, names, hidden0, len(obs_leaves)
+
+
+def export_savedmodel(module, variables, sample_obs, path: str) -> None:
+    """Freeze (module, variables) into a TF SavedModel via jax2tf.
+
+    The bridge artifact for runtimes outside JAX — TF Serving, TFLite,
+    or ONNX via the standard tf2onnx converter where installed — covering
+    the deployment role of the reference's ONNX export
+    (scripts/make_onnx_model.py:28-58).  Naming parity with the reference
+    (``input.N``/``hidden.N`` discovered by prefix, evaluation.py:335-344):
+    observation pytree leaves flatten to ``input_N``, hidden-state leaves
+    to ``hidden_N`` (jax.tree order), outputs to their dict keys plus
+    ``hidden_N`` for the next-step state.  Batch dimension is polymorphic.
+    """
+    import tensorflow as tf
+    from jax.experimental import jax2tf
+
+    fn, leaves, names, hidden0, n_obs = _bridge_fn(module, variables, sample_obs)
     converted = jax2tf.convert(
-        fn, polymorphic_shapes=[poly(l) for l in leaves], with_gradient=False
+        fn, polymorphic_shapes=[_poly(l) for l in leaves], with_gradient=False
     )
     m = tf.Module()
     m.f = tf.function(
         converted,
-        input_signature=[tf_spec(l, n) for l, n in zip(leaves, names)],
+        input_signature=[_tf_spec(l, n) for l, n in zip(leaves, names)],
         autograph=False,
     )
     # keep the pytree structure + initial hidden alongside the graph so the
@@ -174,11 +187,112 @@ def export_savedmodel(module, variables, sample_obs, path: str) -> None:
     os.makedirs(path, exist_ok=True)
     tf.saved_model.save(m, path)
     meta = {
-        "n_obs": len(obs_leaves),
+        "n_obs": n_obs,
         "hidden0": None if hidden0 is None else tree_map(np.asarray, hidden0),
     }
     with open(os.path.join(path, "handyrl_meta.bin"), "wb") as f:
         f.write(codec.dumps(meta))
+
+
+def export_onnx(module, variables, sample_obs, path: str) -> None:
+    """Freeze (module, variables) into a real ``.onnx`` file via
+    jax2tf -> tf2onnx — the reference's exact artifact kind
+    (scripts/make_onnx_model.py:28-58), produced from the same traced
+    function as ``export_savedmodel`` (identical input/output naming,
+    dynamic batch axis).  Requires the optional ``tf2onnx`` dependency;
+    raises ImportError with guidance when it is missing.  A sidecar
+    ``<path>.meta`` carries the pytree structure + initial hidden so
+    ``OnnxModel`` can rebuild framework-shaped inputs/outputs."""
+    try:
+        import tf2onnx
+    except ImportError as exc:  # pragma: no cover - optional dep
+        raise ImportError(
+            "ONNX export needs the optional 'tf2onnx' package "
+            "(pip install tf2onnx); alternatively export a '.tf' "
+            "SavedModel and convert offline with `python -m tf2onnx.convert "
+            "--saved-model <dir> --output model.onnx`"
+        ) from exc
+    import tensorflow as tf
+    from jax.experimental import jax2tf
+
+    from ..runtime import codec
+
+    fn, leaves, names, hidden0, n_obs = _bridge_fn(module, variables, sample_obs)
+    converted = jax2tf.convert(
+        fn,
+        polymorphic_shapes=[_poly(l) for l in leaves],
+        with_gradient=False,
+        # tf2onnx consumes a plain TF graph; XLA custom-call ops
+        # (stablehlo wrappers) are not representable in ONNX
+        native_serialization=False,
+    )
+    f = tf.function(
+        converted,
+        input_signature=[_tf_spec(l, n) for l, n in zip(leaves, names)],
+        autograph=False,
+    )
+    tf2onnx.convert.from_function(
+        f,
+        input_signature=[_tf_spec(l, n) for l, n in zip(leaves, names)],
+        output_path=path,
+    )
+    meta = {
+        "n_obs": n_obs,
+        "hidden0": None if hidden0 is None else tree_map(np.asarray, hidden0),
+    }
+    with open(path + ".meta", "wb") as f2:
+        f2.write(codec.dumps(meta))
+
+
+class OnnxModel(_ArtifactModel):
+    """Inference over a ``.onnx`` artifact via onnxruntime; same API as
+    InferenceModel — the direct counterpart of the reference's OnnxModel
+    (evaluation.py:287-353), including hidden-state discovery by the
+    ``hidden_N`` input-name prefix.  Requires the optional ``onnxruntime``
+    package."""
+
+    def __init__(self, path: str):
+        try:
+            import onnxruntime
+        except ImportError as exc:  # pragma: no cover - optional dep
+            raise ImportError(
+                "loading .onnx artifacts needs the optional 'onnxruntime' "
+                "package (pip install onnxruntime)"
+            ) from exc
+        from ..runtime import codec
+
+        self._sess = onnxruntime.InferenceSession(
+            path, providers=["CPUExecutionProvider"]
+        )
+        with open(path + ".meta", "rb") as f:
+            meta = codec.loads(f.read())
+        self._n_obs = int(meta["n_obs"])
+        self._hidden0 = meta["hidden0"]
+        self._input_names = [i.name for i in self._sess.get_inputs()]
+
+    def inference_batch(self, obs, hidden=None) -> Dict[str, Any]:
+        obs_leaves = jax.tree.leaves(tree_map(np.asarray, obs))
+        if len(obs_leaves) != self._n_obs:
+            raise ValueError(
+                f"observation pytree has {len(obs_leaves)} leaves; the "
+                f"artifact was exported for {self._n_obs}"
+            )
+        if self._hidden0 is not None and hidden is None:
+            hidden = self.init_hidden((obs_leaves[0].shape[0],))
+        hid_leaves = (
+            jax.tree.leaves(tree_map(np.asarray, hidden)) if hidden is not None else []
+        )
+        feeds = dict(zip(self._input_names, obs_leaves + hid_leaves))
+        out_names = [o.name for o in self._sess.get_outputs()]
+        vals = self._sess.run(out_names, feeds)
+        out = dict(zip(out_names, (np.asarray(v) for v in vals)))
+        hid_names = sorted(
+            (k for k in out if k.startswith("hidden_")), key=lambda k: int(k[7:])
+        )
+        if hid_names:
+            _, hid_tree = jax.tree.flatten(self._hidden0)
+            out["hidden"] = jax.tree.unflatten(hid_tree, [out.pop(k) for k in hid_names])
+        return out
 
 
 class SavedModelModel(_ArtifactModel):

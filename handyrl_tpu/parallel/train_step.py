@@ -28,7 +28,7 @@ Forward-prediction semantics parity (train.py:128-187):
 from __future__ import annotations
 
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -84,7 +84,21 @@ def forward_prediction(module, params, batch: Dict[str, Any], args: Dict[str, An
     hidden0 = module.initial_state((B, P1))
 
     if hidden0 is None:
-        outputs = _flat_apply(module, params, obs, (B, T, P1))
+        # Feed-forward compaction: put_batch may have sliced the observation
+        # to the live prefix [0, T_obs) — every later step is end-of-episode
+        # padding whose outputs the masks below zero exactly (make_batch
+        # keeps the valid region a prefix when burn_in is 0).  Compute the
+        # net only on the live steps and zero-pad the outputs back to T:
+        # numerically identical, ~40% fewer forward/backward FLOPs on
+        # short-episode envs like TicTacToe (reference train.py pads the
+        # same windows but always pays full-T compute).
+        T_obs = jax.tree.leaves(obs)[0].shape[1]
+        outputs = _flat_apply(module, params, obs, (B, T_obs, P1))
+        if T_obs < T:
+            outputs = {
+                k: jnp.pad(v, ((0, 0), (0, T - T_obs)) + ((0, 0),) * (v.ndim - 2))
+                for k, v in outputs.items()
+            }
         outputs = {k: v[:, burn_in:] for k, v in outputs.items()}
     elif getattr(module, "supports_seq", False) and args.get("seq_forward", True):
         # whole-window attention path: one batched call instead of a T-step
@@ -231,10 +245,35 @@ class TrainContext:
         self.tx = make_optimizer()
         self._replicated = replicated_sharding(mesh)
         self._batch_shard = batch_sharding(mesh)
+        # Feed-forward batches with burn_in 0 keep their live steps in a
+        # prefix of the T axis (batch.py padding layout); put_batch then
+        # slices the observation to that prefix so the train step skips
+        # compute on end-of-episode padding (see forward_prediction).
+        # Multi-process is excluded: every process must agree on the
+        # global array shape and t_eff is computed from local rows only.
+        self._ff_compact = (
+            module.initial_state((1, 1)) is None
+            and args.get("burn_in_steps", 0) == 0
+            and args.get("compact_padding", True)
+        )
 
         loss_keys = ("p", "v", "r", "ent", "total")
 
         cdt = _compute_dtype(args)
+        if cdt is not None and not getattr(module, "supports_seq", False):
+            # Measured on the v5e (BENCH r2): bf16 is ~2.9x SLOWER than fp32
+            # for the small-conv game nets (7x11 boards, 32 channels) — the
+            # per-conv layout/convert overhead dwarfs the MXU-rate gain at
+            # these shapes.  The knob stays honored (the transformer family
+            # is where it pays); warn so a config doesn't silently regress.
+            import sys
+
+            print(
+                "[handyrl_tpu] compute_dtype=bfloat16 on a conv game net: "
+                "measured SLOWER than float32 at these layer shapes on TPU "
+                "(see BASELINE.md); verify with bench.py before keeping it",
+                file=sys.stderr,
+            )
 
         def _loss_fn(params, batch):
             # mixed precision: bf16 copies feed the forward, fp32 master
@@ -336,6 +375,27 @@ class TrainContext:
         written on any mesh restores onto this one."""
         return self._fresh_put(state_host)
 
+    def _live_steps(self, batch) -> int:
+        """Last T index with any turn/observation activity (+1).  Exact —
+        the distinct-shape set (and so the jit cache) stays tiny in
+        practice because an env's max episode length pins the batch max."""
+        act = np.asarray(batch["turn_mask"]) + np.asarray(batch["observation_mask"])
+        live = act.any(axis=(0, 2, 3))
+        return int(live.nonzero()[0][-1]) + 1 if live.any() else 1
+
+    def _compact_ff(self, batch, t_eff: Optional[int] = None):
+        """Slice the observation to the live prefix (see _ff_compact)."""
+        if not self._ff_compact or jax.process_count() > 1:
+            return batch
+        if t_eff is None:
+            t_eff = self._live_steps(batch)
+        if t_eff >= np.asarray(batch["turn_mask"]).shape[1]:
+            return batch
+        return dict(
+            batch,
+            observation=tree_map(lambda x: x[:, :t_eff], batch["observation"]),
+        )
+
     def put_batch(self, batch: Dict[str, Any]):
         """Lay a host batch out dp-sharded.
 
@@ -344,6 +404,7 @@ class TrainContext:
         process_count rows); every process assembles its own shard and the
         global array is built with make_array_from_process_local_data —
         no cross-host batch traffic."""
+        batch = self._compact_ff(batch)
         return self._put_sharded(batch, self._batch_shard, batch["action"].shape[0])
 
     def train_step(self, state, device_batch, lr: float):
@@ -356,6 +417,9 @@ class TrainContext:
     def put_batches(self, host_batches):
         """Stack k host batches -> one (k, B, ...) device tree, B sharded
         over 'dp' (axis 1), for the fused train_steps path."""
+        if self._ff_compact and jax.process_count() == 1:
+            t_eff = max(self._live_steps(b) for b in host_batches)
+            host_batches = [self._compact_ff(b, t_eff) for b in host_batches]
         stacked = jax.tree.map(lambda *xs: np.stack(xs), *host_batches)
         shard = NamedSharding(self.mesh, PartitionSpec(None, "dp"))
         return self._put_sharded(stacked, shard, host_batches[0]["action"].shape[0])

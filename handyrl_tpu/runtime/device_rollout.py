@@ -165,7 +165,8 @@ class DeviceRollout:
 # ---------------------------------------------------------------------------
 
 
-def build_streaming_fn(venv, module, n_lanes: int, k_steps: int, mesh=None):
+def build_streaming_fn(venv, module, n_lanes: int, k_steps: int, mesh=None,
+                       use_observe_mask: bool = True):
     """Compile-once streaming self-play step for a simultaneous-move vector
     env (``venv.simultaneous``): ``fn(params, state, key) -> (state, record)``
     scans ``k_steps`` game steps over ``n_lanes`` persistent lanes,
@@ -202,9 +203,13 @@ def build_streaming_fn(venv, module, n_lanes: int, k_steps: int, mesh=None):
                     hidden,
                 )
             active = state["active"]                     # (B, P) acting mask
+            # observe_mask (observer views for non-acting players) applies
+            # only under ``observation: true`` — with it false the host
+            # generator records turn players only, and the device path must
+            # emit the same omask semantics into the shared replay store
             observing = (
                 venv.observe_mask(state)
-                if hasattr(venv, "observe_mask")
+                if use_observe_mask and hasattr(venv, "observe_mask")
                 else active
             )
             obs = venv.observation(state)                # leaves (B, P, ...)
@@ -393,7 +398,10 @@ class StreamingDeviceRollout:
         self.n_lanes = n_lanes
         self.k_steps = k_steps
         self.module = module
-        self._fn = build_streaming_fn(venv, module, n_lanes, k_steps, mesh)
+        self._fn = build_streaming_fn(
+            venv, module, n_lanes, k_steps, mesh,
+            use_observe_mask=bool(args.get("observation", False)),
+        )
         self._state = None
         self._hidden = None
         self._pending = None         # in-flight device record (one-call pipeline)

@@ -104,8 +104,8 @@ def build_agent(raw: Any, env=None) -> Optional[Any]:
 
 
 def load_model_agent(model_path: str, env, module=None) -> Agent:
-    """Checkpoint (.ckpt), exported StableHLO (.hlo) or TF SavedModel
-    (.tf directory) path -> greedy Agent.
+    """Checkpoint (.ckpt), exported StableHLO (.hlo), TF SavedModel
+    (.tf directory) or ONNX (.onnx, needs onnxruntime) path -> greedy Agent.
 
     Mirrors reference load_model dispatch (.pth vs .onnx,
     evaluation.py:356-365); exported artifacts need no model code.
@@ -118,6 +118,10 @@ def load_model_agent(model_path: str, env, module=None) -> Agent:
         from ..models.export import SavedModelModel
 
         return Agent(SavedModelModel(model_path))
+    if model_path.endswith(".onnx"):
+        from ..models.export import OnnxModel
+
+        return Agent(OnnxModel(model_path))
     from ..models import init_variables
 
     module = module or env.net()

@@ -10,8 +10,10 @@ Usage:
     python scripts/export_model.py <ckpt_path> [out_path]
 
 ``out_path`` ending in ``.tf`` writes a TF SavedModel via jax2tf instead
-(deployable to TF Serving / TFLite, convertible to ONNX with tf2onnx) —
-the bridge for non-JAX runtimes.
+— the bridge for non-JAX runtimes (TF Serving / TFLite).  ``out_path``
+ending in ``.onnx`` produces the reference's exact artifact kind via
+jax2tf -> tf2onnx; this needs the optional ``tf2onnx`` package and fails
+with guidance when it is missing.
 
 Reads env from ./config.yaml (like the reference reads config.yaml for
 the env to export).
@@ -46,10 +48,14 @@ def main() -> None:
     params = load_params(ckpt, variables["params"])
     env.reset()
     obs = env.observation(env.players()[0])
-    if out.endswith(".tf"):  # TF SavedModel bridge (TFLite / tf2onnx / TF Serving)
+    if out.endswith(".tf"):  # TF SavedModel bridge (TFLite / TF Serving)
         from handyrl_tpu.models.export import export_savedmodel
 
         export_savedmodel(module, {"params": params}, obs, out)
+    elif out.endswith(".onnx"):  # reference-parity ONNX artifact (optional dep)
+        from handyrl_tpu.models.export import export_onnx
+
+        export_onnx(module, {"params": params}, obs, out)
     else:
         export_model(module, {"params": params}, obs, out)
     print(f"exported {ckpt} -> {out}")

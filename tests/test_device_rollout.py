@@ -526,6 +526,39 @@ class TestVectorGeisterParity:
         m = jax.device_get(metrics)
         assert np.isfinite(m["total"]) and m["dcnt"] > 0
 
+    def test_observation_false_records_actors_only(self):
+        """With ``observation: false`` the device path must record turn
+        players only (omask == tmask), matching host-generator episodes in
+        the same store — the observe_mask hook applies only under
+        ``observation: true`` (advisor finding, round 2)."""
+        from handyrl_tpu.envs.vector_geister import VectorGeister
+        from handyrl_tpu.runtime.device_rollout import StreamingDeviceRollout
+
+        env = make_env({"env": "Geister"})
+        module = env.net()
+        variables = init_variables(module, env)
+        cfg = normalize_args({
+            "env_args": {"env": "Geister"},
+            "train_args": {"observation": False},
+        })
+        args = dict(cfg["train_args"])
+        args["env"] = cfg["env_args"]
+        roll = StreamingDeviceRollout(
+            VectorGeister, module, args, n_lanes=8, k_steps=32
+        )
+        key = jax.random.PRNGKey(3)
+        episodes = []
+        for _ in range(8):
+            key, sub = jax.random.split(key)
+            episodes += roll.generate(variables["params"], sub)
+            if episodes:
+                break
+        assert episodes, "no Geister episode finished in 256 plies"
+        cols = [decompress_block(b) for b in episodes[0]["blocks"]]
+        tmask = np.concatenate([c["tmask"] for c in cols])
+        omask = np.concatenate([c["omask"] for c in cols])
+        np.testing.assert_array_equal(omask, tmask)
+
 
 class TestVectorParallelTicTacToe:
     """Streaming rollout on the simultaneous-move TicTacToe variant:

@@ -202,6 +202,106 @@ def test_train_server_with_remote_worker(tmp_path, monkeypatch):
     assert learner.num_returned_episodes >= 22
 
 
+@pytest.mark.slow
+def test_worker_chaos_kill_and_rejoin(tmp_path, monkeypatch):
+    """Actor-plane elasticity under real failure: a remote worker process
+    is SIGKILLed mid-epoch and a fresh one joins — training keeps
+    consuming episodes, finishes every epoch, and shutdown still drains
+    (reference claim: workers join/leave freely, worker.py:199-213; drop
+    handling connection.py:198-224)."""
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    import yaml
+
+    from handyrl_tpu.runtime.learner import Learner
+
+    monkeypatch.chdir(tmp_path)
+    entry_port, data_port = free_port(), free_port()
+    cfg = {
+        "env_args": {"env": "TicTacToe"},
+        "train_args": {
+            "batch_size": 8,
+            "forward_steps": 4,
+            "minimum_episodes": 10,
+            "update_episodes": 12,
+            "maximum_episodes": 200,
+            "epochs": 3,
+            "num_batchers": 1,
+            "eval_rate": 0.2,
+            "mesh": {"dp": 1},  # TCP-transport test, not a sharding test
+            "worker": {
+                "num_parallel": 2,
+                "entry_port": entry_port,
+                "data_port": data_port,
+            },
+        },
+        "worker_args": {
+            "server_address": "localhost",
+            "num_parallel": 2,
+            "entry_port": entry_port,
+        },
+    }
+    args = normalize_args(cfg)
+    with open("config.yaml", "w") as f:
+        yaml.safe_dump(cfg, f)
+
+    learner = Learner(args, remote=True)
+    learner_thread = threading.Thread(target=learner.run, daemon=True)
+    learner_thread.start()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "PYTHONPATH": repo,
+        "HANDYRL_PLATFORM": "cpu",  # a killed process must never hold a chip lease
+    }
+
+    def spawn_worker():
+        return subprocess.Popen(
+            [sys.executable, os.path.join(repo, "main.py"), "--worker"],
+            cwd=tmp_path,
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    victim = spawn_worker()
+    try:
+        # let it join and deliver a few episodes, then kill it without warning
+        deadline = time.time() + 120
+        while learner.num_returned_episodes < 4 and time.time() < deadline:
+            time.sleep(0.5)
+        assert learner.num_returned_episodes >= 4, "first worker never delivered"
+        episodes_before_kill = learner.num_returned_episodes
+    finally:
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=30)
+
+    time.sleep(1.0)  # give the hub a beat to notice the dropped connections
+    replacement = spawn_worker()
+    try:
+        learner_thread.join(timeout=420)
+        assert not learner_thread.is_alive(), "training did not survive the worker kill"
+        # the replacement actually contributed: episode flow resumed past
+        # whatever the victim had delivered before dying
+        assert learner.num_returned_episodes > episodes_before_kill
+        assert os.path.exists("models/latest.ckpt")
+        assert os.path.exists("models/3.ckpt")
+        records = [json.loads(l) for l in open("metrics.jsonl")]
+        assert len(records) >= 3
+    finally:
+        replacement.terminate()
+        try:
+            replacement.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            replacement.kill()
+
+
 # -- network battle mode ----------------------------------------------------
 
 

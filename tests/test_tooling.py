@@ -183,3 +183,32 @@ def test_savedmodel_roundtrip(env_name, tmp_path):
     hidden_b = None if hidden is None else tree_stack([hidden] * 3)
     out = sm.inference_batch(obs_b, hidden_b)
     assert np.asarray(out["policy"]).shape[0] == 3
+
+
+@pytest.mark.parametrize("env_name", ["TicTacToe", "Geister"])
+def test_onnx_roundtrip(env_name, tmp_path):
+    """Real .onnx artifact (jax2tf -> tf2onnx) loaded through onnxruntime
+    matches the live model — the reference's exact deployment path
+    (scripts/make_onnx_model.py:28-58, evaluation.py:287-353).  Skipped
+    where the optional tf2onnx/onnxruntime deps are absent."""
+    pytest.importorskip("tensorflow")
+    pytest.importorskip("tf2onnx")
+    pytest.importorskip("onnxruntime")
+    from handyrl_tpu.models.export import OnnxModel, export_onnx
+
+    env, module, variables, model = _model(env_name)
+    env.reset()
+    obs = env.observation(env.players()[0])
+    path = str(tmp_path / f"{env_name}.onnx")
+    export_onnx(module, variables, obs, path)
+
+    om = OnnxModel(path)
+    o1 = model.inference(obs, model.init_hidden())
+    o2 = om.inference(obs, om.init_hidden())
+    np.testing.assert_allclose(o1["policy"], o2["policy"], rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(o1["value"], o2["value"], rtol=1e-3, atol=1e-4)
+    if o1.get("hidden") is not None:
+        for a, b in zip(
+            jax.tree.leaves(o1["hidden"]), jax.tree.leaves(o2["hidden"])
+        ):
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
