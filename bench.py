@@ -375,13 +375,16 @@ def _generation_bench(env_name: str, overrides, duration: float, num_actors: int
     }
 
 
-def _timed_pipeline_train(pipe, ctx, state, duration: float, on_timed_start=None):
+def _timed_pipeline_train(pipe, ctx, state, duration: float, on_timed_start=None,
+                          on_timed_end=None):
     """Warm the train path on one pipeline batch, then time updates fed by
     the pipeline, accounting time spent waiting on input separately.
     Stretches past ``duration`` until >= 1 update completes (never a
     silent zero).  ``on_timed_start`` fires after the warm-up, right
-    before the clock starts (e.g. to launch a concurrent producer and
-    snapshot its counters in sync with the window).  Returns
+    before the clock starts, and ``on_timed_end`` the moment the window
+    closes — e.g. to launch a concurrent producer and snapshot its
+    counters in sync with the window (work the producer retires after the
+    window must not land in the numerator).  Returns
     (n_updates, wait_s, dt)."""
     import jax
 
@@ -403,7 +406,10 @@ def _timed_pipeline_train(pipe, ctx, state, duration: float, on_timed_start=None
         state, metrics = ctx.train_step(state, batch, 1e-5)
         n += 1
     jax.block_until_ready(metrics["total"])
-    return n, wait_s, time.perf_counter() - t0
+    dt = time.perf_counter() - t0
+    if on_timed_end is not None:
+        on_timed_end()
+    return n, wait_s, dt
 
 
 def _pipeline_bench(train_res, duration: float):
@@ -573,25 +579,34 @@ def _concurrent_northstar_bench(train_res, duration: float,
 
     _note(f"northstar: {len(store)} episodes staged; timing concurrent train+selfplay")
     thread = threading.Thread(target=rollout_loop, daemon=True)
-    counters = {"steps0": 0}
+    counters = {"steps0": 0, "steps1": 0}
 
     def launch_producer():
         counters["steps0"] = roll.game_steps
         thread.start()
 
+    def snapshot_producer():
+        # inside the window only: blocks the producer retires after the
+        # clock stops must not inflate the rate
+        counters["steps1"] = roll.game_steps
+
     n, wait_s, dt = _timed_pipeline_train(
-        pipe, ctx, state, duration, on_timed_start=launch_producer
+        pipe, ctx, state, duration,
+        on_timed_start=launch_producer, on_timed_end=snapshot_producer,
     )
-    steps0 = counters["steps0"]
     stop.set()
     pipe_stop.set()
     thread.join(timeout=120.0)
+    selfplay_rate = (counters["steps1"] - counters["steps0"]) / dt
+    # the lanes shard over the mesh: the aggregate rate divides over every
+    # participating device before comparison against the 3,125/chip target
+    n_chips = ctx.mesh.size
     out = {
         "trained_env_steps_per_sec": n * args["batch_size"] * args["forward_steps"] / dt,
-        "selfplay_env_steps_per_sec": (roll.game_steps - steps0) / dt,
+        "selfplay_env_steps_per_sec": selfplay_rate,
         "input_wait_frac": wait_s / dt,
         "episodes_in_store": len(store),
-        "per_chip_northstar_frac": (roll.game_steps - steps0) / dt / 3125.0,
+        "per_chip_northstar_frac": selfplay_rate / (3125.0 * n_chips),
     }
     if holder["rollout_error"]:
         out["rollout_error"] = holder["rollout_error"]

@@ -260,6 +260,11 @@ def build_streaming_fn(venv, module, n_lanes: int, k_steps: int, mesh=None,
             record["outcome"] = venv.outcome_scores(state)  # final where done
             return (state, hidden), record
 
+        # Stays a genuine loop on every backend: unrolling k_steps bodies
+        # here multiplies compile time by k (measured: minutes per shape on
+        # the 1-core CPU host) for a path whose CPU throughput is a
+        # fallback, not a target — unlike the RNN TRAIN scan, which is
+        # unrolled on single-device CPU (see parallel/train_step.py).
         (state, hidden), records = jax.lax.scan(
             body, (state, hidden), jax.random.split(key, k_steps)
         )

@@ -164,6 +164,46 @@ def test_geister_rnn_train_step():
     assert np.isfinite(m["r"])  # return head in play
 
 
+def test_geister_rnn_unroll_remat_match_scan():
+    """The CPU-fallback strategy (fully unrolled scan) and the TPU strategy
+    (looped scan + jax.checkpoint remat) must produce the same update as
+    the plain loop — same program, different schedule (train_step.py
+    backend-aware scan strategy)."""
+    targs = _args(
+        "Geister",
+        batch_size=4,
+        forward_steps=4,
+        burn_in_steps=2,
+        observation=True,
+        compress_steps=4,
+    )
+    env, module, model, eps = _gen_episodes("Geister", 2, targs, seed=7)
+    store = EpisodeStore(100)
+    store.extend(eps)
+    mesh = make_mesh({"dp": 1})  # single device: the gate under test
+    windows = [store.sample_window(4, 2, 4) for _ in range(4)]
+    host_batch = make_batch(windows, targs)
+
+    results = {}
+    for name, over in {
+        "scan": {"unroll": False, "remat": False},
+        "unroll": {"unroll": True, "remat": False},
+        "remat": {"unroll": False, "remat": True},
+    }.items():
+        ctx = TrainContext(module, dict(targs, **over), mesh)
+        state = ctx.init_state(model.variables["params"])
+        state, metrics = ctx.train_step(state, ctx.put_batch(host_batch), 1e-4)
+        results[name] = (
+            jax.device_get(metrics["total"]),
+            jax.device_get(jax.tree.leaves(state["params"])[0]),
+        )
+    for name in ("unroll", "remat"):
+        np.testing.assert_allclose(results[name][0], results["scan"][0], rtol=2e-5)
+        np.testing.assert_allclose(
+            results[name][1], results["scan"][1], rtol=2e-4, atol=1e-6
+        )
+
+
 def test_block_cache_returns_frozen_identical_columns():
     """Decoded blocks are cached (same object back) and frozen read-only so
     an accidental in-place write cannot corrupt later batches."""
