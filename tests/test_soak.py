@@ -12,8 +12,10 @@ extend the same bar to the two flagship paths the framework exists for:
 * Geister (imperfect-information, README.md:117 family) through the DRC
   ConvLSTM recurrent path with burn-in + UPGO, evaluated against random.
 
-Each asserts (a) the win curve CLIMBS and (b) a floor calibrated from
-probe runs on the 1-core CI host, with the full curve left in
+Geister asserts the per-epoch win curve climbs plus a floor; HungryGeese
+(whose per-epoch evals starve on the 1-core CI host) asserts a decisive
+offline evaluation — trained vs untrained net, matched 240-game evals
+against rule-based seats — plus a floor.  Full curves are left in
 metrics.jsonl for inspection.
 """
 
@@ -35,16 +37,39 @@ def _win_curve(path="metrics.jsonl", key="total"):
     return win
 
 
+def _eval_vs_rulebase(env_args, agent0, num_games: int, num_workers: int = 4):
+    """Win points for ``agent0`` against 3 greedy rule-based seats."""
+    from handyrl_tpu.runtime.evaluation import build_agent, evaluate_mp, wp_func
+
+    agents = {0: agent0}
+    for k in (1, 2, 3):
+        agents[k] = build_agent("rulebase")
+    results = evaluate_mp(env_args, agents, num_games, num_workers)
+    total = {}
+    for res in results.values():
+        for k, v in res.items():
+            total[k] = total.get(k, 0) + v
+    return wp_func(total)
+
+
 @pytest.mark.soak
 @pytest.mark.slow  # belt and braces: CI's `-m "not slow"` overrides addopts
 def test_geese_device_selfplay_beats_rulebase(tmp_path, monkeypatch):
-    """GeeseNet trained ONLY by on-device streaming self-play must climb
-    against the greedy rule-based agent (3 opponent seats).  Win points
-    count a top-half finish as a win (outcome > 0), so random-ish play
-    scores well under 0.5 while food-greedy survival play scores above.
-    """
+    """GeeseNet trained ONLY by on-device streaming self-play must beat the
+    SAME net untrained against the greedy rule-based agent (3 opponent
+    seats), by a decisive offline evaluation after training — per-epoch
+    host evals starve on a 1-core CI host (1-2 games/epoch of pure noise,
+    round-3 probe run), so the learning claim rests on a big matched
+    eval instead; the noisy per-epoch rulebase curve is still recorded in
+    metrics.jsonl for inspection.  Win points count a top-half finish as
+    a win (outcome > 0).  Margin calibration: each 240-game win-point
+    estimate has std <= sqrt(0.25/240) ~= 0.032, so the matched
+    difference has std <= 0.046 and the +0.08 margin holds the
+    false-pass rate (no learning at all) under ~4%."""
+    from handyrl_tpu.runtime.evaluation import load_model_agent
+
     monkeypatch.chdir(tmp_path)
-    args = normalize_args({
+    cfg = {
         "env_args": {"env": "HungryGeese"},
         "train_args": {
             "turn_based_training": False,
@@ -52,24 +77,42 @@ def test_geese_device_selfplay_beats_rulebase(tmp_path, monkeypatch):
             "batch_size": 32,
             "forward_steps": 16,
             "minimum_episodes": 60,
-            "update_episodes": 60,
-            "maximum_episodes": 2000,
-            "epochs": 30,
+            "update_episodes": 120,
+            "maximum_episodes": 4000,
+            "epochs": 25,
             "num_batchers": 1,
-            "eval_rate": 0.9,          # host workers exist to measure, not generate
+            # The Learner floors the effective eval rate at
+            # update_episodes**-0.15 (~0.49 here), so the 2 host workers
+            # spend the soak evaluating regardless — point them at the
+            # rule-based opponent so the per-epoch curve means something.
+            "eval_rate": 0.0,
             "device_rollout_games": 64,
-            "worker": {"num_parallel": 4},
+            "worker": {"num_parallel": 2},
             "eval": {"opponent": ["rulebase"]},
         },
-    })
+    }
+    args = normalize_args(cfg)
     Learner(args).run()
 
-    win = _win_curve()
-    assert len(win) >= 20, f"only {len(win)} eval epochs recorded"
-    early = float(np.mean(win[:5]))
-    late = float(np.mean(win[-10:]))
-    assert late > early, f"no climb vs rulebase: {early:.3f} -> {late:.3f}"
-    assert late >= 0.35, f"final win points vs rulebase {late:.3f} (early {early:.3f})"
+    env_args = args["env_args"]
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.models import InferenceModel, init_variables
+    from handyrl_tpu.agents import Agent
+
+    env = make_env(env_args)
+    module = env.net()
+    untrained = Agent(InferenceModel(module, init_variables(module, env)))
+    trained = load_model_agent("models/latest.ckpt", env, module)
+
+    wp_untrained = _eval_vs_rulebase(env_args, untrained, 240)
+    wp_trained = _eval_vs_rulebase(env_args, trained, 240)
+    print(f"win points vs rulebase: untrained {wp_untrained:.3f} -> trained {wp_trained:.3f}")
+    assert wp_trained > wp_untrained + 0.08, (
+        f"no learning signal vs rulebase: {wp_untrained:.3f} -> {wp_trained:.3f}"
+    )
+    assert wp_trained >= 0.30, (
+        f"trained win points vs rulebase below floor: {wp_trained:.3f}"
+    )
 
 
 @pytest.mark.soak
