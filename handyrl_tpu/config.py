@@ -77,6 +77,11 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     # 'bfloat16' runs the forward/backward compute in bf16 (MXU rate)
     # with fp32 master weights; 'float32' is exact
     "compute_dtype": "float32",
+    # multiplies the reference lr schedule (3e-8 x data-count EMA,
+    # train.py:328-332) -- 1.0 is exact parity.  The schedule assumes
+    # GPU-scale update counts; raise it when the update budget is small
+    # (e.g. CI soaks on a slow host).
+    "lr_scale": 1.0,
 }
 
 DEFAULT_WORKER_ARGS: Dict[str, Any] = {
@@ -127,6 +132,8 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
             f"train_args.compute_dtype={train['compute_dtype']!r} "
             "not one of ('float32', 'bfloat16')"
         )
+    if train["lr_scale"] <= 0:
+        raise ValueError(f"train_args.lr_scale must be > 0, got {train['lr_scale']}")
     if "env" not in args.get("env_args", {}):
         raise ValueError("env_args.env is required")
     return args

@@ -143,7 +143,7 @@ class Trainer:
         self.stop_event = threading.Event()
         self.batcher = BatchPipeline(args, self.store, self.ctx, self.stop_event)
 
-        self.default_lr = 3e-8
+        self.default_lr = 3e-8 * args["lr_scale"]
         self.data_cnt_ema = args["batch_size"] * args["forward_steps"]
         self.steps = 0
         self.last_loss: Dict[str, float] = {}

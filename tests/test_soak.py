@@ -76,8 +76,13 @@ def test_geese_device_selfplay_beats_rulebase(tmp_path, monkeypatch):
     lambda 0.7 (target influence decays lambda^k from the end while the
     value net is cold).  That probe also exposed a near-deterministic
     policy at init (entropy 0.004 of ln4; fixed by zero-init output heads
-    in models/nets.py).  This run therefore trains ~5x longer with
-    lambda 0.95 on the fixed init.  Margin calibration: per-game outcome std <= ~0.75, so
+    in models/nets.py).  A second probe (fixed init, lambda 0.95, ~250
+    updates) measured mean outcome -0.136 -> -0.224: at the parity lr
+    (3e-8 x data-count EMA ~= 4e-5 here) 250 updates barely tilt the
+    logits, and greedy argmax of a near-zero policy is a degenerate
+    first-legal-action straight-liner.  The schedule assumes GPU-scale
+    update counts, so this soak runs it at lr_scale 8 with a 2.5x longer
+    epoch budget.  Margin calibration: per-game outcome std <= ~0.75, so
     each 240-game mean has se <= 0.048, the matched difference se <=
     0.068, and the +0.12 margin holds the no-learning false-pass rate
     under ~4%.  The wp floor asserts the headline: the trained net
@@ -93,10 +98,11 @@ def test_geese_device_selfplay_beats_rulebase(tmp_path, monkeypatch):
             "batch_size": 32,
             "forward_steps": 16,
             "lambda": 0.95,
+            "lr_scale": 8.0,
             "minimum_episodes": 100,
             "update_episodes": 150,
             "maximum_episodes": 8000,
-            "epochs": 100,
+            "epochs": 250,
             "num_batchers": 1,
             # The Learner floors the effective eval rate at
             # update_episodes**-0.15 (~0.47 here), so the 2 host workers

@@ -260,3 +260,20 @@ def test_fused_steps_matches_sequential():
             sum(m[k] for m in metrics_seq), mf[k], rtol=1e-5
         )
     assert int(jax.device_get(state2["steps"])) == 2
+
+
+def test_lr_scale_multiplies_reference_schedule():
+    """lr_scale: 1.0 is exact reference parity (3e-8 x data-count EMA,
+    train.py:328-332); k multiplies the whole schedule, steps decay and
+    EMA dynamics untouched."""
+    from handyrl_tpu.runtime.trainer import Trainer
+
+    env = make_env({"env": "TicTacToe"})
+    module = env.net()
+    params = init_variables(module, env)["params"]
+    mesh = make_mesh({"dp": 1})
+    scaled = Trainer(_args(lr_scale=8.0), module, params, mesh)
+    assert scaled.default_lr == pytest.approx(8.0 * 3e-8)
+    lr0 = scaled.lr
+    scaled.steps = 1000
+    assert scaled.lr == pytest.approx(lr0 / (1 + 1000 * 1e-5))
