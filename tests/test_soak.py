@@ -147,32 +147,42 @@ def test_geese_device_selfplay_beats_rulebase(tmp_path, monkeypatch):
 def test_geister_drc_beats_random(tmp_path, monkeypatch):
     """GeisterNet (DRC ConvLSTM) through the recurrent burn-in + UPGO path
     must climb against random play — 'compiles and loss goes down' is not
-    the bar for the imperfect-information flagship."""
+    the bar for the imperfect-information flagship.
+
+    Sizing (1-core CI host, round-3 probe run): a DRC update at batch 16 x
+    window 12 takes ~60 s wall under worker contention, i.e. a 25-epoch /
+    update_episodes-40 run ends after ~25 updates — no budget to learn.
+    This config halves the batch (~30 s/update), runs the lr schedule at
+    lr_scale 16 (see docs/parameters.md), and sizes epochs so the run
+    lasts ~2.5 h (~300 updates): epochs x update_episodes / ~1.3
+    episodes/s of worker throughput.  Win rates are averaged over epoch
+    windows because per-epoch eval games are few (~10-40)."""
     monkeypatch.chdir(tmp_path)
     args = normalize_args({
         "env_args": {"env": "Geister"},
         "train_args": {
             "observation": True,
-            "batch_size": 16,
+            "batch_size": 8,
             "forward_steps": 8,
             "burn_in_steps": 4,
             "policy_target": "UPGO",
             "value_target": "UPGO",
+            "lr_scale": 16.0,
             "minimum_episodes": 40,
-            "update_episodes": 40,
-            "maximum_episodes": 1500,
-            "epochs": 25,
+            "update_episodes": 80,
+            "maximum_episodes": 3000,
+            "epochs": 140,
             "num_batchers": 1,
             "eval_rate": 0.3,
-            "worker": {"num_parallel": 6},
+            "worker": {"num_parallel": 4},
             "eval": {"opponent": ["random"]},
         },
     })
     Learner(args).run()
 
     win = _win_curve()
-    assert len(win) >= 15, f"only {len(win)} eval epochs recorded"
-    early = float(np.mean(win[:5]))
-    late = float(np.mean(win[-8:]))
+    assert len(win) >= 40, f"only {len(win)} eval epochs recorded"
+    early = float(np.mean(win[:20]))
+    late = float(np.mean(win[-20:]))
     assert late > early, f"no climb vs random: {early:.3f} -> {late:.3f}"
     assert late >= 0.55, f"final win rate vs random {late:.3f} (early {early:.3f})"
