@@ -322,6 +322,34 @@ class VectorHungryGeese:
         return (gt - lt).astype(jnp.float32) / (NUM_AGENTS - 1)
 
     @staticmethod
+    def view_obs(compact, player):
+        """Device-side observation planes for ONE selected player per row:
+        ``compact`` leaves are (N, T, ...) gathered training windows of the
+        record() fields, ``player`` is (N,) int32.  Returns (N, T, 17, 7, 11)
+        float32 — the same planes as observation()/episode_obs() for that
+        player, built with a per-row player-axis rotation instead of
+        stacking all P views (the device replay samples one target player
+        per window, make_batch parity).  Unmasked: the caller applies the
+        observation mask."""
+        occ = compact["occ"].astype(jnp.float32)             # (N, T, P, C)
+        heads = _onehot_cell(compact["head"].astype(jnp.int32)).astype(jnp.float32)
+        tails = _onehot_cell(compact["tail"].astype(jnp.int32)).astype(jnp.float32)
+        prev = _onehot_cell(compact["prev_head"].astype(jnp.int32)).astype(jnp.float32)
+        food = compact["food"].astype(jnp.float32)           # (N, T, C)
+
+        # jnp.roll(x, -p, axis) rotates player q -> (q + p) % P: gather that
+        # order per row (player is traced, so a static roll cannot apply)
+        order = (player[:, None] + jnp.arange(NUM_AGENTS)) % NUM_AGENTS  # (N, P)
+        idx = order[:, None, :, None]                        # broadcast (N,T,P,C)
+        roll_p = lambda x: jnp.take_along_axis(x, idx, axis=2)
+        planes = jnp.concatenate(
+            [roll_p(heads), roll_p(tails), roll_p(occ), roll_p(prev),
+             food[:, :, None, :]],
+            axis=2,
+        )                                                    # (N, T, 17, C)
+        return planes.reshape(planes.shape[:3] + (ROWS, COLS))
+
+    @staticmethod
     def episode_obs(compact, active):
         """Rebuild (T, P, 17, 7, 11) observation planes from the compact
         record, exactly as the host env builds them

@@ -1,0 +1,450 @@
+"""Device-resident replay: self-play records -> training batches, all on device.
+
+The streaming self-play path (runtime/device_rollout.py) still round-trips
+every episode device -> host (episode assembly, EpisodeStore) -> device
+(make_batch + a ~43 MB observation upload per HungryGeese update).  The
+round-3 TPU capture measured that loop at 499 trained + 400 self-play
+env-steps/s on one chip — bounded entirely by those transfers, not by
+compute.  This module removes the host from the data path:
+
+    build_streaming_fn records (K, B, ...)        [device, 1 dispatch]
+      -> ingest() into per-lane step RING BUFFERS [device, 1 dispatch]
+      -> sample() windows + assemble the train batch + SGD step(s)
+                                                  [device, 1 dispatch]
+
+The only host traffic left is scalar counters and the dispatches
+themselves.  The reference has no analogue — its replay is host pickles
+(train.py:271-319) because its actors are host processes; a device ring is
+the design point TPU self-play makes natural.
+
+Ring invariants (what makes exact episode bookkeeping cheap):
+
+* Every lane writes exactly one record per game step (finished lanes
+  auto-reset, so there are no gaps): the write head is ONE scalar ``g``
+  (global step count) and slot ``s`` of every lane holds global step
+  ``gs(s) = g-1 - ((g-1-s) mod S)``.
+* Slots are therefore overwritten oldest-first, and training windows only
+  ever read FORWARD (younger slots) — so invalidating just the slot being
+  overwritten is exact: a still-valid window start can never reach an
+  overwritten step, and a long episode simply loses its oldest window
+  starts one by one.
+* Episode ids ARE global start steps (``ep_start_g``), unique per lane,
+  so finalizing an episode (write ``ep_end_g``, set ``valid``) is one
+  masked compare per step; outcome/length/progress all derive from the
+  two id rings, no outcome broadcast needed (the final record's
+  ``outcome`` field is gathered from the end slot at sample time).
+
+Sampling parity with the host path (replay.py:110-140 + batch.py):
+window starts are uniform over the legal ``train_start`` range
+``[0, max(0, steps - forward_steps)]`` of every finished episode still
+fully resident; one target player uniform per window
+(``turn_based_training: false`` semantics, batch.py:62-67); padding past
+the episode end reproduces make_batch exactly (prob 1, action-mask all
+illegal, value frozen at the outcome, progress 1, episode_mask 0) —
+pinned key-by-key against make_batch by tests/test_device_replay.py.
+Two deliberate deviations, both documented here: recency bias is the
+ring's finite capacity (oldest data falls out) instead of the reference's
+per-episode acceptance curve (train.py:292-303), and window starts are
+uniform over eligible STEPS, which weights episodes by the number of
+windows they contain rather than uniformly.
+
+Scope (checked at construction): simultaneous-move vector envs with the
+compact-record hooks + a ``view_obs`` device view, feed-forward nets
+(``initial_state() is None``), ``burn_in_steps: 0``,
+``turn_based_training: false`` — the north-star HungryGeese configuration.
+Recurrent/turn-based batches keep the host path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..utils import tree_map
+
+ILLEGAL = 1e32
+
+# record fields consumed positionally by the ring (everything else the
+# streaming fn emits is an env compact-obs field, stored as-is)
+_CONTROL = ("done",)
+
+
+def _lane_sharding(mesh, tree):
+    """Lane-leading arrays shard over 'dp'; scalars replicate."""
+
+    def shard(x):
+        if getattr(x, "ndim", 0) >= 1:
+            return NamedSharding(mesh, PartitionSpec("dp"))
+        return NamedSharding(mesh, PartitionSpec())
+
+    return tree_map(shard, tree)
+
+
+class DeviceReplay:
+    """Per-lane device ring buffers + jitted ingest / sample-and-train.
+
+    ``slots`` is the ring length in steps per lane.  It does NOT need to
+    exceed the env's max episode length: an episode longer than the ring
+    keeps its most recent ``slots`` steps sampleable (older window starts
+    fall out exactly as if overwritten), because invalidation is by
+    episode id and windows only ever read forward (younger slots).
+    """
+
+    def __init__(self, venv, module, args: Dict[str, Any], mesh,
+                 n_lanes: int, slots: int = 1024):
+        if not getattr(venv, "simultaneous", False) or not hasattr(venv, "record"):
+            raise ValueError(
+                "device_replay needs a simultaneous-move vector env with "
+                f"compact-record streaming hooks; {getattr(venv, '__name__', type(venv).__name__)} lacks them"
+            )
+        if not hasattr(venv, "view_obs"):
+            raise ValueError(
+                f"device_replay needs {getattr(venv, '__name__', type(venv).__name__)}.view_obs (device-side "
+                "single-player observation reconstruction)"
+            )
+        if module.initial_state((1, 1)) is not None:
+            raise ValueError(
+                "device_replay supports feed-forward nets only; recurrent "
+                "training needs whole-episode windows — use the host path"
+            )
+        if args.get("burn_in_steps", 0) != 0:
+            raise ValueError("device_replay requires burn_in_steps: 0")
+        if args.get("turn_based_training", True):
+            raise ValueError("device_replay requires turn_based_training: false")
+        dp = mesh.shape.get("dp", 1)
+        if n_lanes % dp:
+            raise ValueError(f"n_lanes {n_lanes} not divisible by dp axis {dp}")
+        self.venv = venv
+        self.module = module
+        self.args = args
+        self.mesh = mesh
+        self.n_lanes = n_lanes
+        self.slots = slots
+        self.rings = None        # built lazily from the first record batch
+        self._ingest = None
+        self._train_fns: Dict[int, Any] = {}
+        self._sample_debug = None
+
+    # -- ring construction --------------------------------------------------
+
+    def _init_rings(self, rec_spec: Dict[str, Any]):
+        """Allocate rings matching one step's record layout (``rec_spec``
+        leaves are per-step (B, ...), the K axis already dropped)."""
+        B, S = self.n_lanes, self.slots
+
+        def ring(leaf):
+            return jnp.zeros((B, S) + leaf.shape[1:], leaf.dtype)
+
+        rings = {
+            "rec": {
+                k: ring(v) for k, v in rec_spec.items() if k not in _CONTROL
+            },
+            "ep_start_g": jnp.full((B, S), -1, jnp.int32),
+            "ep_end_g": jnp.full((B, S), -1, jnp.int32),
+            "valid": jnp.zeros((B, S), bool),
+            "cur_start_g": jnp.zeros((B,), jnp.int32),
+            "g": jnp.zeros((), jnp.int32),
+        }
+        sharding = _lane_sharding(self.mesh, rings)
+        return jax.jit(lambda t: t, out_shardings=sharding)(rings), sharding
+
+    # -- ingest -------------------------------------------------------------
+
+    def _build_ingest(self, rec_sharding):
+        B, S = self.n_lanes, self.slots
+
+        def write_step(rings, rec_t):
+            g = rings["g"]
+            pos = g % S
+            # (1) write the record; invalidating ONLY the overwritten slot
+            # is exact: slots are overwritten oldest-first and windows read
+            # forward (younger slots), so a still-valid start slot can
+            # never reach an overwritten step — an episode losing its
+            # oldest slots just loses those window starts
+            rec = {
+                k: rings["rec"][k].at[:, pos].set(v)
+                for k, v in rec_t.items()
+                if k not in _CONTROL
+            }
+            ep_start_g = rings["ep_start_g"].at[:, pos].set(rings["cur_start_g"])
+            ep_end_g = rings["ep_end_g"].at[:, pos].set(-1)
+            valid = rings["valid"].at[:, pos].set(False)
+            # (2) finished lanes: finalize every slot of the current episode
+            done = rec_t["done"]                                     # (B,)
+            # episode ids (global start steps) are unique per lane forever,
+            # so this compare can never hit a stale slot of another episode
+            mine = ep_start_g == rings["cur_start_g"][:, None]       # (B, S)
+            fin = done[:, None] & mine
+            ep_end_g = jnp.where(fin, g, ep_end_g)
+            valid = valid | fin
+            cur_start_g = jnp.where(done, g + 1, rings["cur_start_g"])
+            return {
+                "rec": rec,
+                "ep_start_g": ep_start_g,
+                "ep_end_g": ep_end_g,
+                "valid": valid,
+                "cur_start_g": cur_start_g,
+                "g": g + 1,
+            }
+
+        def ingest(rings, records):
+            def body(rings, rec_t):
+                return write_step(rings, rec_t), None
+
+            rings, _ = jax.lax.scan(body, rings, records)
+            # counters for host bookkeeping (epoch cadence, gen stats):
+            done = records["done"]                                    # (K, B)
+            active = records["active"]                                # (K, B, P)
+            n_done = done.sum(dtype=jnp.int32)
+            # mean self-play outcome over finished episodes, per player
+            # (zero-sum envs hover at 0 — reported for parity with
+            # feed_episodes' generation stats)
+            out_sum = (records["outcome"] * done[..., None]).sum(axis=(0, 1))
+            stats = {
+                "episodes": n_done,
+                "game_steps": (active.sum(axis=2) > 0).sum(dtype=jnp.int32),
+                "player_steps": active.sum(dtype=jnp.int32),
+                "outcome_sum": out_sum,
+            }
+            return rings, stats
+
+        ring_shard = _lane_sharding(self.mesh, self.rings)
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        stats_shard = {
+            "episodes": rep, "game_steps": rep, "player_steps": rep,
+            "outcome_sum": rep,
+        }
+        return jax.jit(
+            ingest,
+            donate_argnums=(0,),
+            in_shardings=(ring_shard, rec_sharding),
+            out_shardings=(ring_shard, stats_shard),
+        )
+
+    def ingest(self, records) -> Dict[str, Any]:
+        """Fold a (K, B, ...) record batch (one streaming-fn call) into the
+        rings.  Returns device-scalar stats (fetch lazily/rarely)."""
+        if self.rings is None:
+            spec = tree_map(lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), records)
+            self.rings, _ = self._init_rings(spec)
+        if self._ingest is None:
+            rec_sharding = tree_map(
+                lambda x: NamedSharding(self.mesh, PartitionSpec(None, "dp")), records
+            )
+            self._ingest = self._build_ingest(rec_sharding)
+        from ..parallel.mesh import dispatch_serialized
+
+        self.rings, stats = dispatch_serialized(
+            lambda: self._ingest(self.rings, records)
+        )
+        return stats
+
+    def eligible_count(self) -> int:
+        """Number of sampleable window starts (host sync — call before the
+        first train step, not per step)."""
+        if self.rings is None:
+            return 0
+        return int(jax.device_get(_eligibility(self.rings, self.args["forward_steps"]).sum()))
+
+    # -- sample + train -----------------------------------------------------
+
+    def _sample(self, rings, key, batch_size: int):
+        return _sample_batch(
+            rings, key, batch_size, self.venv, self.args, self._sample_debug
+        )
+
+    def sample(self, key, batch_size: int, with_info: bool = False):
+        """Eager one-off sampling (tests / inspection).  The production
+        path fuses _sample into train_fn's single dispatch instead."""
+        info = [] if with_info else None
+        self._sample_debug = info
+        try:
+            batch = self._sample(self.rings, key, batch_size)
+        finally:
+            self._sample_debug = None
+        if with_info:
+            return batch, tree_map(np.asarray, info[0])
+        return batch
+
+    def train_fn(self, ctx, fused_steps: int = 1):
+        """Jitted ``fn(state, rings, key, lr) -> (state, metrics)`` running
+        ``fused_steps`` sample+SGD updates in ONE dispatch (metrics summed,
+        matching TrainContext.train_steps).  The state layout is pinned on
+        both sides like TrainContext._bind; rings enter read-only."""
+        if fused_steps in self._train_fns:
+            return self._train_fns[fused_steps]
+        from ..parallel.mesh import param_shardings
+
+        B = self.args["batch_size"]
+        step_fn = ctx._step_fn
+
+        def one(state, rings, key, lr):
+            batch = self._sample(rings, key, B)
+            return step_fn(state, batch, lr)
+
+        def fn(state, rings, key, lr):
+            if fused_steps == 1:
+                return one(state, rings, key, lr)
+
+            def body(state, k):
+                return one(state, rings, k, lr)
+
+            state, metrics = jax.lax.scan(
+                body, state, jax.random.split(key, fused_steps),
+                unroll=jax.default_backend() == "cpu" and self.mesh.size == 1,
+            )
+            return state, jax.tree.map(lambda m: m.sum(axis=0), metrics)
+
+        # state shardings are bound at first call (shapes unknown here)
+        holder = {}
+
+        def bound(state, rings, key, lr):
+            if "fn" not in holder:
+                ss = param_shardings(self.mesh, state)
+                ring_shard = _lane_sharding(self.mesh, rings)
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                holder["fn"] = jax.jit(
+                    fn,
+                    donate_argnums=(0,),
+                    in_shardings=(ss, ring_shard, rep, rep),
+                    out_shardings=(ss, rep),
+                )
+            from ..parallel.mesh import dispatch_serialized
+
+            return dispatch_serialized(
+                lambda: holder["fn"](state, rings, key, jnp.float32(lr))
+            )
+
+        self._train_fns[fused_steps] = bound
+        return bound
+
+
+def _slot_gsteps(g, S: int):
+    """Global step held by each slot: the latest write < g congruent to the
+    slot index mod S (meaningful only where valid — guarded by callers)."""
+    s = jnp.arange(S, dtype=jnp.int32)
+    return g - 1 - ((g - 1 - s) % S)
+
+
+def _eligibility(rings, forward_steps: int):
+    """(B, S) bool — slots that are legal window STARTS: part of a finished
+    resident episode, with in-episode index inside the host sampler's
+    ``train_start`` range [0, max(0, steps - forward_steps)]
+    (replay.py:124)."""
+    S = rings["valid"].shape[1]
+    gs = _slot_gsteps(rings["g"], S)[None, :]              # (1, S)
+    idx_in_ep = gs - rings["ep_start_g"]                   # (B, S)
+    ep_len = rings["ep_end_g"] - rings["ep_start_g"] + 1
+    max_start = jnp.maximum(0, ep_len - forward_steps)
+    return rings["valid"] & (idx_in_ep <= max_start)
+
+
+def _sample_batch(rings, key, batch_size: int, venv, args: Dict[str, Any],
+                  debug: Optional[list] = None) -> Dict[str, Any]:
+    """Assemble a (batch_size, T, 1, ...) training batch from the rings —
+    the device twin of replay.sample_window + batch.make_batch for the
+    simultaneous / feed-forward / single-target-player configuration."""
+    B_l, S = rings["valid"].shape
+    T = args["forward_steps"]
+    P = venv.num_players
+    gamma = args["gamma"]
+    k_start, k_player = jax.random.split(key)
+
+    ok = _eligibility(rings, T)
+    logits = jnp.where(ok.reshape(-1), 0.0, -jnp.inf)
+    flat = jax.random.categorical(k_start, logits, shape=(batch_size,))
+    lane = (flat // S).astype(jnp.int32)                   # (N,)
+    slot = (flat % S).astype(jnp.int32)
+    player = jax.random.randint(k_player, (batch_size,), 0, P)
+    if debug is not None:
+        debug.append({"lane": lane, "slot": slot, "player": player})
+
+    gs0 = _slot_gsteps(rings["g"], S)[slot]                # (N,) global start
+    ep_start = rings["ep_start_g"][lane, slot]
+    ep_end = rings["ep_end_g"][lane, slot]
+    idx0 = gs0 - ep_start                                  # in-episode index
+    ep_len = (ep_end - ep_start + 1).astype(jnp.float32)
+
+    j = jnp.arange(T, dtype=jnp.int32)                     # (T,)
+    wslots = (slot[:, None] + j[None, :]) % S              # (N, T)
+    live_b = gs0[:, None] + j[None, :] <= ep_end[:, None]  # (N, T) bool
+    live = live_b.astype(jnp.float32)
+
+    def gather(x):                                         # (B, S, ...) -> (N, T, ...)
+        return x[lane[:, None], wslots]
+
+    rec = rings["rec"]
+
+    def pick_player(x):                                    # (N, T, P, ...) -> (N, T)
+        idx = player.reshape(-1, 1, 1)
+        idx = jnp.broadcast_to(idx, (batch_size, x.shape[1], 1))
+        idx = idx.reshape(idx.shape + (1,) * (x.ndim - 3))
+        return jnp.take_along_axis(x, idx, axis=2)[:, :, 0]
+
+    act_p = pick_player(gather(rec["active"]).astype(jnp.float32))     # (N, T)
+    obs_p = pick_player(gather(rec["observing"]).astype(jnp.float32))
+    prob_p = pick_player(gather(rec["prob"]))
+    value_p = pick_player(gather(rec["value"]))
+    action_p = pick_player(gather(rec["action"]))
+    legal_p = pick_player(gather(rec["legal"]))                        # (N, T, A)
+
+    # final outcome lives in the episode's END slot record
+    end_slot = (slot + (ep_end - gs0)) % S
+    outcome_all = rec["outcome"][lane, end_slot]                       # (N, P)
+    outcome_p = jnp.take_along_axis(outcome_all, player[:, None], axis=1)[:, 0]
+
+    tmask = live * act_p                                   # (N, T)
+    omask = live * obs_p
+
+    compact = {
+        k: gather(v)
+        for k, v in rec.items()
+        if k not in ("active", "observing", "legal", "action", "prob",
+                     "value", "outcome")
+    }
+    planes = venv.view_obs(compact, player)                # (N, T, planes, R, C)
+    obs = planes * omask[:, :, None, None, None]
+    obs = obs[:, :, None]                                  # (N, T, 1, planes, R, C)
+
+    amask = jnp.where(
+        legal_p & (tmask[..., None] > 0), 0.0, ILLEGAL
+    ).astype(jnp.float32)[:, :, None]                      # (N, T, 1, A)
+
+    # per-step constant reward and its discounted return-to-go
+    # (_streaming_episode's reverse accumulation in closed form)
+    step_reward = float(getattr(venv, "step_reward", 0.0))
+    if step_reward:
+        n_t = (ep_end[:, None] - (gs0[:, None] + j[None, :]) + 1).astype(jnp.float32)
+        if gamma == 1.0:
+            ret = step_reward * n_t
+        else:
+            ret = step_reward * (1 - gamma ** n_t) / (1 - gamma)
+        reward = live * step_reward
+        ret = live * ret
+    else:
+        reward = jnp.zeros((batch_size, T), jnp.float32)
+        ret = reward
+
+    progress = jnp.where(
+        live_b, (idx0[:, None] + j[None, :]).astype(jnp.float32) / ep_len[:, None], 1.0
+    )
+
+    exp = lambda x: x[:, :, None, None]                    # (N, T) -> (N, T, 1, 1)
+    return {
+        "observation": obs,
+        "selected_prob": exp(jnp.where(tmask > 0, prob_p, 1.0)),
+        "value": exp(jnp.where(live_b, value_p * obs_p, outcome_p[:, None])),
+        "action": exp(jnp.where(tmask > 0, action_p, 0).astype(jnp.int32)),
+        "outcome": outcome_p[:, None, None, None],
+        "reward": exp(reward),
+        "return": exp(ret),
+        "episode_mask": exp(live),
+        "turn_mask": exp(tmask),
+        "observation_mask": exp(omask),
+        "action_mask": amask,
+        "progress": progress[:, :, None],
+    }

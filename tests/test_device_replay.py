@@ -1,0 +1,233 @@
+"""Device-resident replay (runtime/device_replay.py) parity tests.
+
+The bar: a window sampled and assembled ON DEVICE must equal, key by key,
+the batch the host path (StreamingDeviceRollout episode assembly ->
+EpisodeStore window -> make_batch) builds for the SAME episode, window
+start, and target player.  Both paths consume the identical streaming-fn
+records, so every difference is an assembly bug, not sampling noise.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from handyrl_tpu.config import normalize_args
+from handyrl_tpu.envs import make_env
+from handyrl_tpu.envs.vector_hungry_geese import VectorHungryGeese
+from handyrl_tpu.models import init_variables
+from handyrl_tpu.parallel import TrainContext, make_mesh
+from handyrl_tpu.runtime.batch import make_batch
+from handyrl_tpu.runtime.device_replay import DeviceReplay
+from handyrl_tpu.runtime.device_rollout import _streaming_episode, build_streaming_fn
+from handyrl_tpu.utils import tree_map
+
+N_LANES = 8
+K_STEPS = 32
+N_CALLS = 10          # 320 steps > SLOTS: the ring wraps and invalidation runs
+SLOTS = 192
+
+
+def _args():
+    cfg = normalize_args(
+        {
+            "env_args": {"env": "HungryGeese"},
+            "train_args": {
+                "turn_based_training": False,
+                "observation": False,
+                "batch_size": 8,
+                "forward_steps": 8,
+                "burn_in_steps": 0,
+            },
+        }
+    )
+    args = dict(cfg["train_args"])
+    args["env"] = cfg["env_args"]
+    return args
+
+
+@pytest.fixture(scope="module")
+def rollout_data():
+    """Drive the streaming fn once; return (records over all calls, host
+    episodes with [lane, g0, g1] spans, replay with everything ingested,
+    module/params/args)."""
+    env = make_env({"env": "HungryGeese"})
+    module = env.net()
+    params = init_variables(module, env)["params"]
+    args = _args()
+    venv = VectorHungryGeese
+
+    mesh = make_mesh({"dp": 1})
+    fn = build_streaming_fn(venv, module, N_LANES, K_STEPS, mesh=None,
+                            use_observe_mask=False)
+    replay = DeviceReplay(venv, module, args, mesh, N_LANES, slots=SLOTS)
+
+    state = venv.init(N_LANES, jax.random.PRNGKey(7))
+    hidden = module.initial_state((N_LANES, venv.num_players))
+    key = jax.random.PRNGKey(42)
+    chunks = []
+    for _ in range(N_CALLS):
+        key, sub = jax.random.split(key)
+        state, hidden, records = fn(params, state, hidden, sub)
+        records = jax.device_get(records)
+        chunks.append(records)
+        replay.ingest(tree_map(np.asarray, records))
+
+    full = tree_map(lambda *xs: np.concatenate(xs), *chunks)  # (G, B, ...)
+    G = N_CALLS * K_STEPS
+
+    episodes = []                     # (lane, g0, g1, host episode dict)
+    done = full["done"]               # (G, B)
+    for b in range(N_LANES):
+        g0 = 0
+        for g1 in np.flatnonzero(done[:, b]):
+            g1 = int(g1)
+            ep = _streaming_episode(venv, [(full, g0, g1 + 1)], full, g1, b, args)
+            episodes.append((b, g0, g1, ep))
+            g0 = g1 + 1
+    assert len(episodes) >= 10, "rollout produced too few finished episodes"
+    return {
+        "episodes": episodes, "replay": replay, "module": module,
+        "params": params, "args": args, "G": G, "mesh": mesh,
+    }
+
+
+def _host_window(ep, train_start, args):
+    """Reconstruct the exact sample_window dict (replay.py:110-140) for a
+    forced train_start (burn_in 0: start == train_start)."""
+    fwd, cs = args["forward_steps"], args["compress_steps"]
+    steps = ep["steps"]
+    start = train_start
+    end = min(train_start + fwd, steps)
+    first_block = start // cs
+    last_block = (end - 1) // cs + 1
+    return {
+        "args": ep["args"],
+        "outcome": np.asarray([ep["outcome"][p] for p in ep["players"]], np.float32),
+        "players": ep["players"],
+        "blocks": ep["blocks"][first_block:last_block],
+        "base": first_block * cs,
+        "start": start,
+        "end": end,
+        "train_start": train_start,
+        "total": steps,
+    }
+
+
+def test_sampled_windows_match_make_batch(rollout_data, monkeypatch):
+    """Key-by-key equality of device-assembled windows vs make_batch on the
+    same (episode, train_start, target player)."""
+    replay = rollout_data["replay"]
+    args = rollout_data["args"]
+    episodes = rollout_data["episodes"]
+    G, S = rollout_data["G"], SLOTS
+
+    n = 48
+    batch, info = replay.sample(jax.random.PRNGKey(3), n, with_info=True)
+    batch = tree_map(np.asarray, batch)
+
+    matched = 0
+    for i in range(n):
+        lane, slot, player = int(info["lane"][i]), int(info["slot"][i]), int(info["player"][i])
+        gs0 = G - 1 - ((G - 1 - slot) % S)    # global step held by the slot
+        hits = [e for e in episodes if e[0] == lane and e[1] <= gs0 <= e[2]]
+        assert hits, f"sampled slot maps to no finished episode (lane {lane}, g {gs0})"
+        b, g0, g1, ep = hits[0]
+        # the device only samples eligible starts: finished episode, within
+        # the host sampler's train_start range
+        train_start = gs0 - g0
+        assert train_start <= max(0, ep["steps"] - args["forward_steps"])
+
+        monkeypatch.setattr(
+            "handyrl_tpu.runtime.batch.random.randrange", lambda _n: player
+        )
+        host = make_batch([_host_window(ep, train_start, args)], args)
+
+        for key in host:
+            dev = batch[key][i : i + 1]
+            if key == "observation":
+                for hl, dl in zip(jax.tree.leaves(host[key]), jax.tree.leaves(dev)):
+                    np.testing.assert_allclose(dl, hl, atol=1e-6, err_msg=key)
+            else:
+                np.testing.assert_allclose(
+                    dev, host[key], atol=1e-6, err_msg=f"{key} row {i}"
+                )
+        matched += 1
+    assert matched == n
+
+
+def test_eligibility_and_wrap(rollout_data):
+    """After the ring wraps, every eligible slot belongs to a finished,
+    still-resident episode — and partially-overwritten episodes only offer
+    window starts whose full window is resident."""
+    from handyrl_tpu.runtime.device_replay import _eligibility
+
+    replay = rollout_data["replay"]
+    episodes = rollout_data["episodes"]
+    args = rollout_data["args"]
+    G, S = rollout_data["G"], SLOTS
+    assert G > S, "test must exercise ring wrap"
+
+    ok = np.asarray(_eligibility(replay.rings, args["forward_steps"]))
+    assert ok.any(), "no eligible slots after ingest"
+    spans = {}
+    for b, g0, g1, ep in episodes:
+        spans.setdefault(b, []).append((g0, g1))
+    for b in range(N_LANES):
+        for s in np.flatnonzero(ok[b]):
+            gs = G - 1 - ((G - 1 - int(s)) % S)
+            in_ep = [sp for sp in spans.get(b, []) if sp[0] <= gs <= sp[1]]
+            assert in_ep, f"eligible slot outside any finished episode (lane {b})"
+            g0, g1 = in_ep[0]
+            # episode end must still be resident (windows read forward)
+            assert g1 > G - 1 - S
+
+
+def test_train_fn_runs_and_updates(rollout_data):
+    """Fused sample+SGD from the rings: finite loss, params actually move,
+    metrics summed over fused steps (dcnt ~ fused * batch turn sum)."""
+    replay = rollout_data["replay"]
+    module, params, args = (
+        rollout_data["module"], rollout_data["params"], rollout_data["args"],
+    )
+    ctx = TrainContext(module, args, rollout_data["mesh"])
+    state = ctx.init_state(params)
+    before = jax.device_get(state["params"])
+    fn = replay.train_fn(ctx, fused_steps=2)
+    state, metrics = fn(state, replay.rings, jax.random.PRNGKey(5), 1e-3)
+    m = jax.device_get(metrics)
+    assert np.isfinite(m["total"]) and m["dcnt"] > 0
+    after = jax.device_get(state["params"])
+    diffs = [
+        float(np.abs(a - b).max())
+        for a, b in zip(jax.tree.leaves(after), jax.tree.leaves(before))
+    ]
+    assert max(diffs) > 0, "params did not move"
+    assert int(jax.device_get(state["steps"])) == 2
+
+
+def test_ingest_stats_match_records(rollout_data):
+    """Ingest counters must agree with host-side counting of the same
+    records (episodes finished, game/player steps)."""
+    env = make_env({"env": "HungryGeese"})
+    module = env.net()
+    params = init_variables(module, env)["params"]
+    args = rollout_data["args"]
+    mesh = rollout_data["mesh"]
+    fn = build_streaming_fn(VectorHungryGeese, module, 4, 16, mesh=None,
+                            use_observe_mask=False)
+    replay = DeviceReplay(VectorHungryGeese, module, args, mesh, 4, slots=64)
+    state = VectorHungryGeese.init(4, jax.random.PRNGKey(1))
+    key = jax.random.PRNGKey(2)
+    tot = {"episodes": 0, "game_steps": 0, "player_steps": 0}
+    for _ in range(6):
+        key, sub = jax.random.split(key)
+        state, _, records = fn(params, state, None, sub)
+        records = tree_map(np.asarray, jax.device_get(records))
+        stats = tree_map(np.asarray, replay.ingest(records))
+        assert stats["episodes"] == records["done"].sum()
+        assert stats["game_steps"] == (records["active"].sum(axis=2) > 0).sum()
+        assert stats["player_steps"] == records["active"].sum()
+        for k in tot:
+            tot[k] += int(stats[k])
+    assert tot["episodes"] > 0 and tot["game_steps"] >= tot["episodes"]
+    assert replay.eligible_count() > 0
