@@ -676,7 +676,7 @@ def _device_replay_northstar_bench(train_res, duration: float,
 
     train = replay.train_fn(ctx, fused_steps=fused_steps)
     # warm both executables outside the timed window
-    state, m = train(state, replay.rings, jax.random.PRNGKey(13), 1e-5)
+    state, m = train(state, jax.random.PRNGKey(13), 1e-5)
     jax.block_until_ready(m["total"])
 
     _note("northstar2: timing the all-on-device loop")
@@ -691,7 +691,7 @@ def _device_replay_northstar_bench(train_res, duration: float,
         rollout_s += time.perf_counter() - tr
         for _ in range(2):
             key, sub = jax.random.split(key)
-            state, m = train(state, replay.rings, sub, 1e-5)
+            state, m = train(state, sub, 1e-5)
             updates += fused_steps
         dt = time.perf_counter() - t0
         if dt >= duration and updates > 0:

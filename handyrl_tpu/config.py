@@ -63,6 +63,18 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     # per jit call (envs exposing a vector twin, e.g. TicTacToe). Workers
     # then skew toward evaluation; 0 = host actors only.
     "device_rollout_games": 0,
+    # true: keep the self-play data on device end to end — rollout records
+    # are ingested into device ring buffers and training batches are
+    # sampled + assembled + stepped in one dispatch (runtime/
+    # device_replay.py).  Needs device_rollout_games > 0, a simultaneous
+    # vector env with the view_obs hook, a feed-forward net,
+    # burn_in_steps 0 and turn_based_training false (the north-star
+    # HungryGeese configuration); other configs keep the host replay.
+    "device_replay": False,
+    # ring length in steps per lane for device_replay
+    "device_replay_slots": 1024,
+    # game steps advanced per rollout dispatch in the device_replay loop
+    "device_replay_k_steps": 32,
     "metrics_path": "metrics.jsonl",
     "model_dir": "models",
     "battle_port": 9876,
@@ -117,6 +129,19 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError("train_args.fused_steps must be >= 1")
     if train["device_rollout_games"] < 0:
         raise ValueError("train_args.device_rollout_games must be >= 0")
+    if train["device_replay"]:
+        if train["device_rollout_games"] <= 0:
+            raise ValueError(
+                "train_args.device_replay needs device_rollout_games > 0 "
+                "(the lane count of the streaming rollout it feeds from)"
+            )
+        # the remaining constraints (env hooks, feed-forward net, burn-in,
+        # turn_based_training) are checked by DeviceReplay at Learner
+        # startup, where the env/net are known
+        if train["device_replay_slots"] <= train["forward_steps"]:
+            raise ValueError("train_args.device_replay_slots must exceed forward_steps")
+        if train["device_replay_k_steps"] < 1:
+            raise ValueError("train_args.device_replay_k_steps must be >= 1")
     # observation: true with device_rollout_games is validated per-env at
     # Learner startup: streaming vector envs with an observe_mask hook
     # (Geister) record observer views; turn-player-only envs must refuse

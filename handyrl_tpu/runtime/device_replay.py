@@ -126,8 +126,13 @@ class DeviceReplay:
         self.slots = slots
         self.rings = None        # built lazily from the first record batch
         self._ingest = None
+        self._pending = None     # last dispatched stats (drain target)
         self._train_fns: Dict[int, Any] = {}
         self._sample_debug = None
+        self.counters = {
+            "episodes": 0, "game_steps": 0, "player_steps": 0,
+            "outcome_sum": 0.0, "outcome_sq_sum": 0.0,
+        }
 
     # -- ring construction --------------------------------------------------
 
@@ -209,6 +214,7 @@ class DeviceReplay:
                 "game_steps": (active.sum(axis=2) > 0).sum(dtype=jnp.int32),
                 "player_steps": active.sum(dtype=jnp.int32),
                 "outcome_sum": out_sum,
+                "outcome_sq_sum": (records["outcome"] ** 2 * done[..., None]).sum(),
             }
             return rings, stats
 
@@ -216,7 +222,7 @@ class DeviceReplay:
         rep = NamedSharding(self.mesh, PartitionSpec())
         stats_shard = {
             "episodes": rep, "game_steps": rep, "player_steps": rep,
-            "outcome_sum": rep,
+            "outcome_sum": rep, "outcome_sq_sum": rep,
         }
         return jax.jit(
             ingest,
@@ -227,7 +233,13 @@ class DeviceReplay:
 
     def ingest(self, records) -> Dict[str, Any]:
         """Fold a (K, B, ...) record batch (one streaming-fn call) into the
-        rings.  Returns device-scalar stats (fetch lazily/rarely)."""
+        rings.  Returns device-scalar stats (fetch lazily/rarely).
+
+        The ring swap happens INSIDE the dispatch lock: ingest donates the
+        old ring buffers the moment it dispatches, so a concurrent train
+        dispatch must never read ``self.rings`` between the two — both
+        paths read/replace it under DISPATCH_LOCK (train_fn reads it
+        inside its locked lambda the same way)."""
         if self.rings is None:
             spec = tree_map(lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), records)
             self.rings, _ = self._init_rings(spec)
@@ -238,10 +250,32 @@ class DeviceReplay:
             self._ingest = self._build_ingest(rec_sharding)
         from ..parallel.mesh import dispatch_serialized
 
-        self.rings, stats = dispatch_serialized(
-            lambda: self._ingest(self.rings, records)
-        )
+        def _run():
+            rings, stats = self._ingest(self.rings, records)
+            self.rings = rings
+            self._pending = stats
+            return stats
+
+        return dispatch_serialized(_run)
+
+    def ingest_counted(self, records) -> Dict[str, float]:
+        """ingest + synchronous host fetch of the stats, accumulated into
+        ``self.counters`` — the learner-integration path, which needs
+        episode counts for epoch cadence anyway (one scalar fetch per
+        k_steps-sized rollout call)."""
+        stats = tree_map(np.asarray, jax.device_get(self.ingest(records)))
+        self.counters["episodes"] += int(stats["episodes"])
+        self.counters["game_steps"] += int(stats["game_steps"])
+        self.counters["player_steps"] += int(stats["player_steps"])
+        self.counters["outcome_sum"] += float(stats["outcome_sum"].sum())
+        self.counters["outcome_sq_sum"] += float(stats["outcome_sq_sum"])
         return stats
+
+    def drain(self) -> None:
+        """Block on the last in-flight ingest (see StreamingDeviceRollout
+        .drain: exiting the process mid-execution aborts XLA)."""
+        if self._pending is not None:
+            jax.block_until_ready(self._pending)
 
     def eligible_count(self) -> int:
         """Number of sampleable window starts (host sync — call before the
@@ -271,10 +305,12 @@ class DeviceReplay:
         return batch
 
     def train_fn(self, ctx, fused_steps: int = 1):
-        """Jitted ``fn(state, rings, key, lr) -> (state, metrics)`` running
-        ``fused_steps`` sample+SGD updates in ONE dispatch (metrics summed,
-        matching TrainContext.train_steps).  The state layout is pinned on
-        both sides like TrainContext._bind; rings enter read-only."""
+        """Jitted ``fn(state, key, lr) -> (state, metrics)`` running
+        ``fused_steps`` sample+SGD updates from the CURRENT rings in ONE
+        dispatch (metrics summed, matching TrainContext.train_steps).  The
+        state layout is pinned on both sides like TrainContext._bind; the
+        rings are read under DISPATCH_LOCK (see ingest) so a concurrent
+        ingest can never hand the train step donated buffers."""
         if fused_steps in self._train_fns:
             return self._train_fns[fused_steps]
         from ..parallel.mesh import param_shardings
@@ -302,10 +338,10 @@ class DeviceReplay:
         # state shardings are bound at first call (shapes unknown here)
         holder = {}
 
-        def bound(state, rings, key, lr):
+        def bound(state, key, lr):
             if "fn" not in holder:
                 ss = param_shardings(self.mesh, state)
-                ring_shard = _lane_sharding(self.mesh, rings)
+                ring_shard = _lane_sharding(self.mesh, self.rings)
                 rep = NamedSharding(self.mesh, PartitionSpec())
                 holder["fn"] = jax.jit(
                     fn,
@@ -315,8 +351,9 @@ class DeviceReplay:
                 )
             from ..parallel.mesh import dispatch_serialized
 
+            # self.rings is read INSIDE the locked lambda — see ingest
             return dispatch_serialized(
-                lambda: holder["fn"](state, rings, key, jnp.float32(lr))
+                lambda: holder["fn"](state, self.rings, key, jnp.float32(lr))
             )
 
         self._train_fns[fused_steps] = bound

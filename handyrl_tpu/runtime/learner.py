@@ -110,6 +110,7 @@ class Learner:
         # stepping + inference + sampling in one jit call per batch of
         # games; workers then mostly evaluate
         self._device_games = int(self.args.get("device_rollout_games", 0))
+        self._replay = None        # set below in device_replay mode
         self._next_update_episodes = (
             self.args["minimum_episodes"] + self.args["update_episodes"]
         )
@@ -131,12 +132,35 @@ class Learner:
             # constructed HERE so misconfiguration (e.g. lane count not
             # divisible by the mesh's dp axis) fails the run at startup
             # instead of silently killing the rollout daemon thread
-            from .device_rollout import make_device_rollout
+            if self.args.get("device_replay"):
+                # data stays on device end to end: rollout records ->
+                # ring buffers -> sampled batches -> SGD, one dispatch
+                # each (runtime/device_replay.py); DeviceReplay validates
+                # the env/net/config constraints here, at startup
+                from .device_replay import DeviceReplay
+                from .device_rollout import build_streaming_fn
 
-            self._device_roll = make_device_rollout(
-                self._venv, self.module, self.args, self._device_games,
-                mesh=self.trainer.ctx.mesh,
-            )
+                mesh = self.trainer.ctx.mesh
+                self._replay = DeviceReplay(
+                    self._venv, self.module, self.args, mesh,
+                    self._device_games,
+                    slots=self.args["device_replay_slots"],
+                )
+                self._stream_fn = build_streaming_fn(
+                    self._venv, self.module, self._device_games,
+                    self.args["device_replay_k_steps"],
+                    mesh=mesh if mesh.size > 1 else None,
+                    use_observe_mask=bool(self.args["observation"]),
+                )
+                self.trainer.device_replay = self._replay
+                self._device_roll = None
+            else:
+                from .device_rollout import make_device_rollout
+
+                self._device_roll = make_device_rollout(
+                    self._venv, self.module, self.args, self._device_games,
+                    mesh=self.trainer.ctx.mesh,
+                )
 
     # -- request plumbing ---------------------------------------------------
 
@@ -262,7 +286,11 @@ class Learner:
 
     def _assign_role(self) -> Dict[str, Any]:
         args: Dict[str, Any] = {"model_id": {}}
-        if self.num_results < self.eval_rate * self.num_episodes:
+        # device_replay: generation lives entirely on device (host episodes
+        # could not enter the ring buffers — they would be stored but never
+        # trained on, while racing the epoch cadence), so host workers
+        # evaluate only
+        if self._replay is not None or self.num_results < self.eval_rate * self.num_episodes:
             args["role"] = "e"
             players = self.env.players()
             me = players[self.num_results % len(players)]
@@ -316,6 +344,21 @@ class Learner:
                 self.feed_episodes(data)
                 self.num_episodes += len(data)
                 fut.set_result(None)
+            elif req == "device_counts":
+                # device-replay mode: episodes never materialize on host —
+                # the rollout thread reports ingest counters instead, which
+                # feed the same books (epoch cadence, generation stats,
+                # eval_rate balance) as feed_episodes would
+                n, P = data["episodes"], data["players"]
+                st = self.generation_results.get(data["model_id"], (0, 0, 0))
+                self.generation_results[data["model_id"]] = (
+                    st[0] + n * P,
+                    st[1] + data["outcome_sum"],
+                    st[2] + data["outcome_sq_sum"],
+                )
+                self.num_returned_episodes += n
+                self.num_episodes += n
+                fut.set_result(None)
             elif req == "result":
                 self.feed_results([data] if not isinstance(data, list) else data)
                 fut.set_result(None)
@@ -354,8 +397,14 @@ class Learner:
         flooding the store)."""
         import jax
 
-        roll = self._device_roll
         key = jax.random.PRNGKey(self.args["seed"] + 0x5EED)
+        if self._device_roll is None:          # device_replay mode
+            try:
+                self._device_replay_inner(key)
+            finally:
+                self._replay.drain()
+            return
+        roll = self._device_roll
         try:
             self._device_rollout_inner(roll, key)
         finally:
@@ -364,6 +413,53 @@ class Learner:
             # StreamingDeviceRollout.drain)
             if hasattr(roll, "drain"):
                 roll.drain()
+
+    def _device_replay_inner(self, key) -> None:
+        """Streaming rollout -> device-ring ingest; only scalar counters
+        reach the host, reported to the server loop for the books."""
+        import jax
+
+        from ..parallel.mesh import dispatch_serialized
+
+        key, k0 = jax.random.split(key)
+        vstate = self._venv.init(self._device_games, k0)
+        hidden = self.module.initial_state(
+            (self._device_games, self._venv.num_players)
+        )
+        while not self.shutdown_flag:
+            if self.num_returned_episodes >= self._next_update_episodes:
+                time.sleep(0.02)   # epoch episode budget met: yield the chip
+                continue
+            epoch, params = self.model_server.latest_snapshot()
+            key, sub = jax.random.split(key)
+            vstate, hidden, records = dispatch_serialized(
+                lambda: self._stream_fn(params, vstate, hidden, sub)
+            )
+            stats = self._replay.ingest_counted(records)
+            n = int(stats["episodes"])
+            if self.shutdown_flag:
+                return
+            if n == 0:
+                continue
+            counts = {
+                "episodes": n,
+                "players": self._venv.num_players,
+                "model_id": epoch,
+                "outcome_sum": float(stats["outcome_sum"].sum()),
+                "outcome_sq_sum": float(stats["outcome_sq_sum"]),
+            }
+            # same patience loop as _device_rollout_inner: the server can
+            # be busy for minutes at an epoch boundary
+            fut: Future = Future()
+            self._requests.put(("device_counts", counts, fut))
+            while not fut.done():
+                try:
+                    fut.result(timeout=5.0)
+                except (TimeoutError, FutureTimeoutError):
+                    if self.shutdown_flag:
+                        return
+                except Exception:
+                    return
 
     def _device_rollout_inner(self, roll, key) -> None:
         import jax

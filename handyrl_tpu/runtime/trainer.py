@@ -143,6 +143,12 @@ class Trainer:
         self.stop_event = threading.Event()
         self.batcher = BatchPipeline(args, self.store, self.ctx, self.stop_event)
 
+        # device-resident replay (runtime/device_replay.py): set by the
+        # Learner before run() when train_args.device_replay is true; the
+        # SGD loop then samples on device instead of pulling host batches
+        self.device_replay = None
+        self._replay_key = jax.random.PRNGKey(args["seed"] ^ 0x7EA1)
+
         self.default_lr = 3e-8 * args["lr_scale"]
         self.data_cnt_ema = args["batch_size"] * args["forward_steps"]
         self.steps = 0
@@ -199,7 +205,7 @@ class Trainer:
         Before the warmup threshold no training has happened — return
         immediately so the learner keeps serving (reference train.py:343-346).
         """
-        if len(self.store) < self.args["minimum_episodes"]:
+        if not self._warmed_up():
             return None, self.steps
         self.update_flag = True
         while not self.stop_event.is_set():
@@ -209,6 +215,14 @@ class Trainer:
                 continue
         return None, self.steps
 
+    def _warmed_up(self) -> bool:
+        """Epoch boundaries before the warmup threshold return immediately
+        (reference train.py:343-346); device-replay mode counts ingested
+        episodes (the store is bypassed)."""
+        if self.device_replay is not None:
+            return self.device_replay.counters["episodes"] >= self.args["minimum_episodes"]
+        return len(self.store) >= self.args["minimum_episodes"]
+
     def train_epoch(self) -> Any:
         """Train until the learner flags an epoch end; return param snapshot."""
         batch_cnt, data_cnt = 0, 0
@@ -216,21 +230,39 @@ class Trainer:
         lr = self.lr
         wait_s = 0.0
         t_epoch = time.perf_counter()
-        fused = self.args.get("fused_steps", 1)
-        while data_cnt == 0 or not self.update_flag:
-            t0 = time.perf_counter()
-            batch = self.batcher.batch()
-            wait_s += time.perf_counter() - t0  # input starvation (north-star)
-            if batch is None:  # shutting down
-                break
-            if fused > 1:  # k updates per device call, metrics pre-summed
-                self.state, metrics = self.ctx.train_steps(self.state, batch, lr)
-            else:
-                self.state, metrics = self.ctx.train_step(self.state, batch, lr)
-            metric_accum.append(metrics)
-            batch_cnt += fused
-            self.steps += fused
-            data_cnt = 1  # real count resolved below without device sync per step
+        fused = max(1, self.args.get("fused_steps", 1))
+        if self.device_replay is not None:
+            # all-on-device SGD: sample + assemble + step in one dispatch.
+            # One-deep pipelining (block on update N-1 before dispatching
+            # N+1) keeps the dispatch queue shallow so the concurrent
+            # rollout thread gets device time at every boundary.
+            train = self.device_replay.train_fn(self.ctx, fused)
+            while data_cnt == 0 or not self.update_flag:
+                if self.stop_event.is_set():
+                    break
+                self._replay_key, sub = jax.random.split(self._replay_key)
+                self.state, metrics = train(self.state, sub, lr)
+                if metric_accum:
+                    jax.block_until_ready(metric_accum[-1]["total"])
+                metric_accum.append(metrics)
+                batch_cnt += fused
+                self.steps += fused
+                data_cnt = 1
+        else:
+            while data_cnt == 0 or not self.update_flag:
+                t0 = time.perf_counter()
+                batch = self.batcher.batch()
+                wait_s += time.perf_counter() - t0  # input starvation (north-star)
+                if batch is None:  # shutting down
+                    break
+                if fused > 1:  # k updates per device call, metrics pre-summed
+                    self.state, metrics = self.ctx.train_steps(self.state, batch, lr)
+                else:
+                    self.state, metrics = self.ctx.train_step(self.state, batch, lr)
+                metric_accum.append(metrics)
+                batch_cnt += fused
+                self.steps += fused
+                data_cnt = 1  # real count resolved below without device sync per step
         if not metric_accum:
             return self.state_host["params"]
 
@@ -257,11 +289,12 @@ class Trainer:
 
     def run(self):
         print("waiting training")
-        while len(self.store) < self.args["minimum_episodes"]:
+        while not self._warmed_up():
             if self.stop_event.is_set():
                 return
             time.sleep(1)
-        self.batcher.start()
+        if self.device_replay is None:
+            self.batcher.start()
         print("started training")
         profile_dir = self.args.get("profile_dir")
         tracing = False

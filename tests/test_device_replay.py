@@ -193,7 +193,7 @@ def test_train_fn_runs_and_updates(rollout_data):
     state = ctx.init_state(params)
     before = jax.device_get(state["params"])
     fn = replay.train_fn(ctx, fused_steps=2)
-    state, metrics = fn(state, replay.rings, jax.random.PRNGKey(5), 1e-3)
+    state, metrics = fn(state, jax.random.PRNGKey(5), 1e-3)
     m = jax.device_get(metrics)
     assert np.isfinite(m["total"]) and m["dcnt"] > 0
     after = jax.device_get(state["params"])
@@ -203,6 +203,54 @@ def test_train_fn_runs_and_updates(rollout_data):
     ]
     assert max(diffs) > 0, "params did not move"
     assert int(jax.device_get(state["steps"])) == 2
+
+
+def test_learner_device_replay_end_to_end(tmp_path, monkeypatch):
+    """Full --train stack with device_replay: the data path never builds a
+    host episode, yet epochs advance, generation stats are booked from
+    ingest counters, checkpoints land, and metrics.jsonl records updates."""
+    import json
+    import os
+
+    from handyrl_tpu.runtime.learner import Learner
+
+    monkeypatch.chdir(tmp_path)
+    cfg = normalize_args({
+        "env_args": {"env": "HungryGeese"},
+        "train_args": {
+            "turn_based_training": False,
+            "observation": False,
+            "batch_size": 8,
+            "forward_steps": 8,
+            "minimum_episodes": 10,
+            # the epoch cadence is episode-counted (reference semantics):
+            # size the budget so the run outlasts the one-off CPU compile
+            # of the fused sample+train step, else it ends with 0 updates
+            "update_episodes": 40,
+            "maximum_episodes": 1000,
+            "epochs": 2,
+            "eval_rate": 0.0,
+            "device_rollout_games": 8,
+            "device_replay": True,
+            "device_replay_slots": 256,
+            "device_replay_k_steps": 16,
+            "worker": {"num_parallel": 1},
+        },
+    })
+    learner = Learner(cfg)
+    learner.run()
+
+    records = [json.loads(l) for l in open("metrics.jsonl")]
+    assert len(records) == 2
+    assert records[-1]["steps"] > 0, "no SGD updates ran"
+    assert records[-1]["episodes"] >= 80, "episode counters did not reach epoch 2"
+    # generation stats came from device counters (host saw no episodes)
+    assert any("generation_mean" in r for r in records)
+    assert os.path.exists("models/latest.ckpt")
+    assert os.path.exists("models/state.ckpt")
+    assert learner.trainer.store.total_added == 0, (
+        "device_replay must not materialize host episodes"
+    )
 
 
 def test_ingest_stats_match_records(rollout_data):

@@ -380,7 +380,9 @@ class TestStreamingRollout:
             cols = [decompress_block(b) for b in ep["blocks"]]
             obs = np.concatenate([c["obs"] for c in cols])
             assert obs.shape[1:] == (4, 17, 7, 11)
-            assert abs(sum(ep["outcome"].values())) < 1e-9
+            # float32 rank-ladder outcomes: a two-way tie (-2/3 twice) sums
+            # to ~3e-8, not 0.0 — the zero-sum bound must be fp32-scale
+            assert abs(sum(ep["outcome"].values())) < 1e-6
 
     def test_lanes_stitch_across_calls(self):
         """Episodes longer than k_steps must span device calls.  The

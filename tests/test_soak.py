@@ -110,6 +110,11 @@ def test_geese_device_selfplay_beats_rulebase(tmp_path, monkeypatch):
             # rule-based opponent so the per-epoch curve means something.
             "eval_rate": 0.0,
             "device_rollout_games": 64,
+            # the learning proof doubles as the device-resident-replay
+            # proof: data never leaves the device between self-play and
+            # SGD (runtime/device_replay.py); host workers are eval-only
+            # in this mode by design
+            "device_replay": True,
             "worker": {"num_parallel": 2},
             "eval": {"opponent": ["rulebase"]},
         },
