@@ -119,6 +119,21 @@ class VectorParallelTicTacToe:
         return jnp.stack([w, -w], axis=1)
 
     @staticmethod
+    def view_obs(compact, player):
+        """Device-side single-player observation planes per row:
+        ``compact['cells']`` (N, T, 9) + ``player`` (N,) int32 ->
+        (N, T, 3, 3, 3), the same planes as observation()/episode_obs()
+        for that player (device-replay hook, runtime/device_replay.py).
+        Unmasked: the caller applies the observation mask."""
+        grid = compact["cells"].astype(jnp.int8).reshape(
+            compact["cells"].shape[:2] + (3, 3)
+        )                                                    # (N, T, 3, 3)
+        color = jnp.asarray(COLORS, jnp.int8)[player][:, None, None, None]
+        mine = (grid == color).astype(jnp.float32)
+        theirs = (grid == -color).astype(jnp.float32)
+        return jnp.stack([jnp.ones_like(mine), mine, theirs], axis=2)
+
+    @staticmethod
     def episode_obs(compact, active):
         """(T, P, 3, 3, 3) from recorded cells, mirroring observation()."""
         cells = compact["cells"].astype(np.int8)             # (T, 9)

@@ -27,10 +27,10 @@ N_CALLS = 10          # 320 steps > SLOTS: the ring wraps and invalidation runs
 SLOTS = 192
 
 
-def _args():
+def _args(env_name: str = "HungryGeese"):
     cfg = normalize_args(
         {
-            "env_args": {"env": "HungryGeese"},
+            "env_args": {"env": env_name},
             "train_args": {
                 "turn_based_training": False,
                 "observation": False,
@@ -45,27 +45,26 @@ def _args():
     return args
 
 
-@pytest.fixture(scope="module")
-def rollout_data():
-    """Drive the streaming fn once; return (records over all calls, host
-    episodes with [lane, g0, g1] spans, replay with everything ingested,
-    module/params/args)."""
-    env = make_env({"env": "HungryGeese"})
+def _drive_rollout(env_name: str, venv, n_lanes: int, k_steps: int,
+                   n_calls: int, slots: int):
+    """Drive the streaming fn once; return the host episodes (with their
+    [lane, g0, g1] global-step spans) and a DeviceReplay holding the SAME
+    records — the two sides every parity check compares."""
+    env = make_env({"env": env_name})
     module = env.net()
     params = init_variables(module, env)["params"]
-    args = _args()
-    venv = VectorHungryGeese
+    args = _args(env_name)
 
     mesh = make_mesh({"dp": 1})
-    fn = build_streaming_fn(venv, module, N_LANES, K_STEPS, mesh=None,
+    fn = build_streaming_fn(venv, module, n_lanes, k_steps, mesh=None,
                             use_observe_mask=False)
-    replay = DeviceReplay(venv, module, args, mesh, N_LANES, slots=SLOTS)
+    replay = DeviceReplay(venv, module, args, mesh, n_lanes, slots=slots)
 
-    state = venv.init(N_LANES, jax.random.PRNGKey(7))
-    hidden = module.initial_state((N_LANES, venv.num_players))
+    state = venv.init(n_lanes, jax.random.PRNGKey(7))
+    hidden = module.initial_state((n_lanes, venv.num_players))
     key = jax.random.PRNGKey(42)
     chunks = []
-    for _ in range(N_CALLS):
+    for _ in range(n_calls):
         key, sub = jax.random.split(key)
         state, hidden, records = fn(params, state, hidden, sub)
         records = jax.device_get(records)
@@ -73,11 +72,11 @@ def rollout_data():
         replay.ingest(tree_map(np.asarray, records))
 
     full = tree_map(lambda *xs: np.concatenate(xs), *chunks)  # (G, B, ...)
-    G = N_CALLS * K_STEPS
+    G = n_calls * k_steps
 
     episodes = []                     # (lane, g0, g1, host episode dict)
     done = full["done"]               # (G, B)
-    for b in range(N_LANES):
+    for b in range(n_lanes):
         g0 = 0
         for g1 in np.flatnonzero(done[:, b]):
             g1 = int(g1)
@@ -88,7 +87,14 @@ def rollout_data():
     return {
         "episodes": episodes, "replay": replay, "module": module,
         "params": params, "args": args, "G": G, "mesh": mesh,
+        "n_lanes": n_lanes, "slots": slots,
     }
+
+
+@pytest.fixture(scope="module")
+def rollout_data():
+    return _drive_rollout("HungryGeese", VectorHungryGeese,
+                          N_LANES, K_STEPS, N_CALLS, SLOTS)
 
 
 def _host_window(ep, train_start, args):
@@ -113,19 +119,16 @@ def _host_window(ep, train_start, args):
     }
 
 
-def test_sampled_windows_match_make_batch(rollout_data, monkeypatch):
+def _check_windows(data, monkeypatch, n: int, seed: int = 3):
     """Key-by-key equality of device-assembled windows vs make_batch on the
     same (episode, train_start, target player)."""
-    replay = rollout_data["replay"]
-    args = rollout_data["args"]
-    episodes = rollout_data["episodes"]
-    G, S = rollout_data["G"], SLOTS
+    replay, args = data["replay"], data["args"]
+    episodes = data["episodes"]
+    G, S = data["G"], data["slots"]
 
-    n = 48
-    batch, info = replay.sample(jax.random.PRNGKey(3), n, with_info=True)
+    batch, info = replay.sample(jax.random.PRNGKey(seed), n, with_info=True)
     batch = tree_map(np.asarray, batch)
 
-    matched = 0
     for i in range(n):
         lane, slot, player = int(info["lane"][i]), int(info["slot"][i]), int(info["player"][i])
         gs0 = G - 1 - ((G - 1 - slot) % S)    # global step held by the slot
@@ -151,8 +154,21 @@ def test_sampled_windows_match_make_batch(rollout_data, monkeypatch):
                 np.testing.assert_allclose(
                     dev, host[key], atol=1e-6, err_msg=f"{key} row {i}"
                 )
-        matched += 1
-    assert matched == n
+
+
+def test_sampled_windows_match_make_batch(rollout_data, monkeypatch):
+    _check_windows(rollout_data, monkeypatch, n=48)
+
+
+def test_parallel_tictactoe_device_replay_parity(monkeypatch):
+    """The second device-replay env: VectorParallelTicTacToe windows must
+    match make_batch the same way (9-step episodes, heavy auto-reset —
+    many episodes per ring cycle, the opposite regime from geese)."""
+    from handyrl_tpu.envs.vector_parallel_tictactoe import VectorParallelTicTacToe
+
+    data = _drive_rollout("ParallelTicTacToe", VectorParallelTicTacToe,
+                          n_lanes=4, k_steps=12, n_calls=6, slots=32)
+    _check_windows(data, monkeypatch, n=32)
 
 
 def test_eligibility_and_wrap(rollout_data):

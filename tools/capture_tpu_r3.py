@@ -1,0 +1,94 @@
+"""Round-3 follow-up TPU capture: the device-replay north-star loop + the
+bf16 HLO question, on the real chip.
+
+Run on the tunneled TPU (NO platform override), in the background, and let
+it EXIT CLEANLY — SIGKILL/SIGTERM on a process that initialized the axon
+backend wedges the chip lease for everyone (see .claude/skills/verify).
+
+    cd /root/repo && nohup python tools/capture_tpu_r3.py > \
+        docs/captures/northstar2_tpu.log 2>&1 &
+
+Captures, in order (each stage isolated so one failure doesn't kill the
+rest):
+  1. northstar2 — the all-on-device loop (bench.py stage, the follow-up to
+     the round-3 verified 499/400 env-steps/s host-replay capture);
+  2. the v1 host-replay north-star loop for a same-session comparison;
+  3. bf16 vs fp32 geese train step (BASELINE.md open item a); launch with
+     XLA_FLAGS=--xla_dump_to=... when the HLO evidence is wanted (the
+     flag parses once, at backend init).
+"""
+
+import json
+import sys
+import time
+import traceback
+
+sys.path.insert(0, "/root/repo")
+
+import bench  # noqa: E402  (repo-root module)
+
+
+def main() -> None:
+    import jax
+
+    out = {"platform": None, "stages": {}}
+    t0 = time.time()
+    devices = jax.devices()
+    out["platform"] = f"{devices[0].platform}:{getattr(devices[0], 'device_kind', '?')} x{len(devices)}"
+    print(f"[{time.time()-t0:.0f}s] devices: {out['platform']}", flush=True)
+
+    args = bench._make_args(
+        "HungryGeese", {"turn_based_training": False, "observation": False}
+    )
+    _, module, model, store = bench._fill_store(args, 12)
+    from handyrl_tpu.parallel import TrainContext, make_mesh
+
+    ctx = TrainContext(module, args, make_mesh(args["mesh"]))
+    gt = {"args": args, "ctx": ctx, "module": module, "model": model,
+          "store": store}
+    print(f"[{time.time()-t0:.0f}s] store filled", flush=True)
+
+    try:
+        ns2 = bench._device_replay_northstar_bench(gt, 12.0)
+        out["stages"]["northstar2"] = ns2
+        print(f"[{time.time()-t0:.0f}s] northstar2: {ns2}", flush=True)
+    except Exception:
+        out["stages"]["northstar2"] = {"error": traceback.format_exc(limit=5)}
+        print(out["stages"]["northstar2"]["error"], flush=True)
+
+    try:
+        ns1 = bench._concurrent_northstar_bench(gt, 12.0)
+        out["stages"]["northstar_v1"] = ns1
+        print(f"[{time.time()-t0:.0f}s] northstar v1: {ns1}", flush=True)
+    except Exception:
+        out["stages"]["northstar_v1"] = {"error": traceback.format_exc(limit=5)}
+        print(out["stages"]["northstar_v1"]["error"], flush=True)
+
+    try:
+        # (an HLO dump needs XLA_FLAGS=--xla_dump_to set BEFORE launch —
+        # the flag is parsed once; launch this script with it when the
+        # dump is wanted)
+        gt_fp32 = bench._train_bench("HungryGeese",
+                                     {"turn_based_training": False,
+                                      "observation": False},
+                                     8.0, len(devices), reuse=gt)
+        gt_bf16 = bench._train_bench(
+            "HungryGeese",
+            {"turn_based_training": False, "observation": False,
+             "compute_dtype": "bfloat16"},
+            8.0, len(devices), reuse=gt,
+        )
+        out["stages"]["bf16"] = {
+            "fp32_updates_per_sec": gt_fp32["updates_per_sec"],
+            "bf16_updates_per_sec": gt_bf16["updates_per_sec"],
+        }
+        print(f"[{time.time()-t0:.0f}s] bf16: {out['stages']['bf16']}", flush=True)
+    except Exception:
+        out["stages"]["bf16"] = {"error": traceback.format_exc(limit=5)}
+        print(out["stages"]["bf16"]["error"], flush=True)
+
+    print("RESULT " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
