@@ -57,7 +57,7 @@ Recurrent/turn-based batches keep the host path.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp

@@ -109,13 +109,21 @@ def test_geese_device_selfplay_beats_rulebase(tmp_path, monkeypatch):
             # spend the soak evaluating regardless — point them at the
             # rule-based opponent so the per-epoch curve means something.
             "eval_rate": 0.0,
-            "device_rollout_games": 64,
+            # 16 lanes, not more: the epoch cadence is episode-counted, so
+            # the update budget per epoch is set by how LONG an epoch's
+            # episodes take to produce — 64 lanes filled the 150-episode
+            # budget in one rollout call and the run measured ~1 update per
+            # epoch (92 updates by epoch 87); 16 lanes spread it over ~6
+            # calls the trainer interleaves with, and fused_steps doubles
+            # the updates per dispatch
+            "device_rollout_games": 16,
             # the learning proof doubles as the device-resident-replay
             # proof: data never leaves the device between self-play and
             # SGD (runtime/device_replay.py); host workers are eval-only
             # in this mode by design
             "device_replay": True,
-            "worker": {"num_parallel": 2},
+            "fused_steps": 2,
+            "worker": {"num_parallel": 1},
             "eval": {"opponent": ["rulebase"]},
         },
     }
