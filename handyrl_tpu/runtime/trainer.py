@@ -141,7 +141,29 @@ class Trainer:
         self.state_host = jax.device_get(self.state)
         self.store = EpisodeStore(args["maximum_episodes"])
         self.stop_event = threading.Event()
-        self.batcher = BatchPipeline(args, self.store, self.ctx, self.stop_event)
+
+        self.fused = max(1, args.get("fused_steps", 1))
+        if self.fused > 1 and jax.default_backend() == "cpu" and mesh.size > 1:
+            # fused updates are a lax.scan whose body XLA:CPU executes
+            # without its fast kernel runtime, with per-step collectives
+            # across VIRTUAL devices sharing one thunk pool — measured as
+            # minutes per dispatch (trainer stack-dumped inside
+            # block_until_ready for 15+ min on the 8-device CPU mesh).
+            # The knob is for real accelerators; degrade loudly.
+            import sys
+
+            print(
+                "[handyrl_tpu] fused_steps > 1 on a multi-device CPU mesh "
+                "executes scan-bodied collectives at pathological speed; "
+                "forcing fused_steps=1 (use a TPU or a {'dp': 1} mesh)",
+                file=sys.stderr,
+            )
+            self.fused = 1
+        # the pipeline groups k host batches per device call iff the
+        # trainer will actually run the fused path — same clamped value
+        self.batcher = BatchPipeline(
+            dict(args, fused_steps=self.fused), self.store, self.ctx, self.stop_event
+        )
 
         # device-resident replay (runtime/device_replay.py): set by the
         # Learner before run() when train_args.device_replay is true; the
@@ -230,13 +252,14 @@ class Trainer:
         lr = self.lr
         wait_s = 0.0
         t_epoch = time.perf_counter()
-        fused = max(1, self.args.get("fused_steps", 1))
+        fused = self.fused
         if self.device_replay is not None:
             # all-on-device SGD: sample + assemble + step in one dispatch.
             # One-deep pipelining (block on update N-1 before dispatching
             # N+1) keeps the dispatch queue shallow so the concurrent
             # rollout thread gets device time at every boundary.
             train = self.device_replay.train_fn(self.ctx, fused)
+            on_cpu = jax.default_backend() == "cpu"
             while data_cnt == 0 or not self.update_flag:
                 if self.stop_event.is_set():
                     break
@@ -248,6 +271,15 @@ class Trainer:
                 batch_cnt += fused
                 self.steps += fused
                 data_cnt = 1
+                if on_cpu:
+                    # On the CPU backend dispatch_serialized blocks INSIDE
+                    # the dispatch lock, and this loop re-acquires it
+                    # microseconds after releasing — an unfair
+                    # threading.Lock then starves the rollout thread
+                    # indefinitely (observed: 35 min, zero episodes).  A
+                    # real sleep hands the lock to the waiting producer;
+                    # on TPU dispatch is async and the gap never forms.
+                    time.sleep(0.02)
         else:
             while data_cnt == 0 or not self.update_flag:
                 t0 = time.perf_counter()
