@@ -111,6 +111,10 @@ class Learner:
         # games; workers then mostly evaluate
         self._device_games = int(self.args.get("device_rollout_games", 0))
         self._replay = None        # set below in device_replay mode
+        # per-epoch device self-play volume -> mean episode length in
+        # metrics.jsonl (the survival signal on episode-length envs)
+        self._device_epoch_eps = 0
+        self._device_epoch_steps = 0
         self._next_update_episodes = (
             self.args["minimum_episodes"] + self.args["update_episodes"]
         )
@@ -256,6 +260,10 @@ class Learner:
             episodes_per_sec=(self.num_returned_episodes - self._epoch_episodes0) / max(now - self._epoch_t0, 1e-6),
             updates_per_sec=(steps - self._epoch_steps0) / max(now - self._epoch_t0, 1e-6),
         )
+        if self._device_epoch_eps:
+            record["device_mean_episode_len"] = self._device_epoch_steps / self._device_epoch_eps
+            self._device_epoch_eps = 0
+            self._device_epoch_steps = 0
         self._epoch_t0 = now
         self._epoch_steps0 = steps
         self._epoch_episodes0 = self.num_returned_episodes
@@ -358,6 +366,8 @@ class Learner:
                 )
                 self.num_returned_episodes += n
                 self.num_episodes += n
+                self._device_epoch_eps += n
+                self._device_epoch_steps += data.get("game_steps", 0)
                 fut.set_result(None)
             elif req == "result":
                 self.feed_results([data] if not isinstance(data, list) else data)
@@ -426,6 +436,7 @@ class Learner:
         hidden = self.module.initial_state(
             (self._device_games, self._venv.num_players)
         )
+        pending_steps = 0   # game steps from batches that finished 0 episodes
         while not self.shutdown_flag:
             if self.num_returned_episodes >= self._next_update_episodes:
                 time.sleep(0.02)   # epoch episode budget met: yield the chip
@@ -439,15 +450,18 @@ class Learner:
             n = int(stats["episodes"])
             if self.shutdown_flag:
                 return
+            pending_steps += int(stats["game_steps"])
             if n == 0:
-                continue
+                continue   # steps stay in pending_steps for the next report
             counts = {
                 "episodes": n,
                 "players": self._venv.num_players,
                 "model_id": epoch,
+                "game_steps": pending_steps,
                 "outcome_sum": float(stats["outcome_sum"].sum()),
                 "outcome_sq_sum": float(stats["outcome_sq_sum"]),
             }
+            pending_steps = 0
             # same patience loop as _device_rollout_inner: the server can
             # be busy for minutes at an epoch boundary
             fut: Future = Future()

@@ -262,6 +262,8 @@ def test_learner_device_replay_end_to_end(tmp_path, monkeypatch):
     assert records[-1]["episodes"] >= 80, "episode counters did not reach epoch 2"
     # generation stats came from device counters (host saw no episodes)
     assert any("generation_mean" in r for r in records)
+    # per-epoch self-play volume -> survival signal in the metrics
+    assert any(r.get("device_mean_episode_len", 0) > 1 for r in records)
     assert os.path.exists("models/latest.ckpt")
     assert os.path.exists("models/state.ckpt")
     assert learner.trainer.store.total_added == 0, (
