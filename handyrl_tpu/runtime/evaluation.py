@@ -232,6 +232,35 @@ def evaluate_mp(env_args: Dict[str, Any], agents: Dict[int, Any], num_games: int
     return results
 
 
+def eval_vs_baseline(env_args: Dict[str, Any], agent0, opponent: str,
+                     num_games: int, num_workers: int = 4):
+    """(win points, mean outcome) for ``agent0`` with every other seat
+    played by ``opponent`` (an agent spec for build_agent, e.g. 'rulebase').
+
+    Mean outcome is the finer signal on rank-ladder envs: HungryGeese
+    outcomes are {-1, -1/3, +1/3, +1} (hungry_geese.py outcome), so the
+    mean moves with every rank gained, while win points only see the
+    top-half/bottom-half boundary.  The learning soaks' margin calibration
+    (tests/test_soak.py) is defined against THIS aggregation — keep the
+    single copy."""
+    env = make_env(env_args)
+    agents: Dict[int, Any] = {0: agent0}
+    for k in env.players()[1:]:
+        opp = build_agent(opponent)
+        if opp is None:
+            raise ValueError(f"unknown baseline opponent spec {opponent!r}")
+        agents[k] = opp
+    results = evaluate_mp(env_args, agents, num_games, num_workers)
+    total: Dict[Any, int] = {}
+    for res in results.values():
+        for k, v in res.items():
+            total[k] = total.get(k, 0) + v
+    scored = {k: v for k, v in total.items() if k is not None}
+    games = sum(scored.values())
+    mean_outcome = sum(k * v for k, v in scored.items()) / max(games, 1)
+    return wp_func(total), mean_outcome
+
+
 def parse_eval_spec(raw: str) -> Dict[str, Any]:
     """`A[:B]` -> {"main": A, "opponent": B or 'random'}.
 

@@ -38,24 +38,11 @@ def _win_curve(path="metrics.jsonl", key="total"):
 
 
 def _eval_vs_rulebase(env_args, agent0, num_games: int, num_workers: int = 4):
-    """(win points, mean outcome) for ``agent0`` against 3 greedy rule-based
-    seats.  Mean outcome is the finer signal: HungryGeese outcomes are the
-    rank ladder {-1, -1/3, +1/3, +1}, so it moves with every rank gained,
-    while win points only see the top-half/bottom-half boundary."""
-    from handyrl_tpu.runtime.evaluation import build_agent, evaluate_mp, wp_func
+    """(win points, mean outcome) vs 3 greedy rule-based seats — the shared
+    margin-calibrated aggregation (runtime/evaluation.py:eval_vs_baseline)."""
+    from handyrl_tpu.runtime.evaluation import eval_vs_baseline
 
-    agents = {0: agent0}
-    for k in (1, 2, 3):
-        agents[k] = build_agent("rulebase")
-    results = evaluate_mp(env_args, agents, num_games, num_workers)
-    total = {}
-    for res in results.values():
-        for k, v in res.items():
-            total[k] = total.get(k, 0) + v
-    scored = {k: v for k, v in total.items() if k is not None}
-    games = sum(scored.values())
-    mean_outcome = sum(k * v for k, v in scored.items()) / max(games, 1)
-    return wp_func(total), mean_outcome
+    return eval_vs_baseline(env_args, agent0, "rulebase", num_games, num_workers)
 
 
 @pytest.mark.soak

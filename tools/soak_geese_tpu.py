@@ -1,0 +1,117 @@
+"""HungryGeese learning soak on the real chip, through device-resident replay.
+
+The committed CPU soak (tests/test_soak.py::test_geese_device_selfplay_beats_rulebase)
+is sized for a 1-core CI host: ~600 updates at lr_scale 8 over hours.  On the
+chip the same loop runs at ~50 updates/s (BASELINE.md northstar2 row), so this
+driver trains with a near-parity schedule (lr_scale 2) and a tens-of-thousands
+update budget — the scale the reference's lr schedule (train.py:328-332,
+3e-8 x data-count EMA) was designed for — in tens of minutes.
+
+Run (background, clean exit — never kill a process holding the axon lease):
+
+    cd /root/repo && nohup python tools/soak_geese_tpu.py train \
+        > docs/captures/soak_geese_tpu.log 2>&1 &
+
+Phase 1 (this process, TPU): Learner.run() with device_replay — self-play,
+ring ingest and SGD all on device; host workers eval-only.  Artifacts land in
+./soak_geese_tpu_run/ (metrics.jsonl + models/latest.ckpt).
+Phase 2 (subprocess, CPU-pinned): matched 240-game evals — the trained net and
+the SAME net untrained, each vs 3 greedy rule-based seats
+(envs/hungry_geese.py rule_based_action) — identical margin calibration to the
+committed soak: mean-outcome difference se <= 0.068, +0.12 margin.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RUN_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "soak_geese_tpu_run")
+
+CFG = {
+    "env_args": {"env": "HungryGeese"},
+    "train_args": {
+        "turn_based_training": False,
+        "observation": False,
+        "batch_size": 32,
+        "forward_steps": 16,
+        "lambda": 0.95,
+        # near-parity schedule: the chip delivers the update counts the
+        # reference schedule assumes, so the 8x CPU-soak boost is not needed
+        "lr_scale": 2.0,
+        "minimum_episodes": 500,
+        "update_episodes": 500,
+        "maximum_episodes": 8000,
+        "epochs": 200,
+        "num_batchers": 1,
+        "eval_rate": 0.0,          # workers are eval-only under device_replay
+        "device_rollout_games": 64,
+        "device_replay": True,
+        "fused_steps": 4,          # amortize tunnel RTT: 4 updates/dispatch
+        "mesh": {"dp": 1},
+        "worker": {"num_parallel": 1},
+        "eval": {"opponent": ["rulebase"]},
+    },
+}
+
+
+def train() -> None:
+    os.makedirs(RUN_DIR, exist_ok=True)
+    os.chdir(RUN_DIR)
+    from handyrl_tpu.config import normalize_args
+    from handyrl_tpu.runtime.learner import Learner
+
+    import jax
+    d = jax.devices()[0]
+    print(f"platform: {d.platform}:{getattr(d, 'device_kind', '?')}", flush=True)
+    Learner(normalize_args(CFG)).run()
+    print("training done; launching CPU-pinned matched eval", flush=True)
+    # the eval subprocess pins CPU itself (jax.config in evaluate());
+    # its verdict is the run's whole point, so its failure is ours
+    rc = subprocess.run([sys.executable, os.path.abspath(__file__), "eval"],
+                        check=False).returncode
+    if rc != 0:
+        print(f"matched eval FAILED (rc={rc})", flush=True)
+    sys.exit(rc)
+
+
+def evaluate() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from handyrl_tpu.agents import Agent
+    from handyrl_tpu.config import normalize_args
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.models import InferenceModel, init_variables
+    from handyrl_tpu.runtime.evaluation import eval_vs_baseline, load_model_agent
+
+    args = normalize_args(CFG)
+    env_args = args["env_args"]
+    env = make_env(env_args)
+    module = env.net()
+
+    def vs_rulebase(agent0, num_games=240):
+        return eval_vs_baseline(env_args, agent0, "rulebase", num_games,
+                                num_workers=4)
+
+    untrained = Agent(InferenceModel(module, init_variables(module, env)))
+    trained = load_model_agent(os.path.join(RUN_DIR, "models", "latest.ckpt"),
+                               env, module)
+    wp_u, out_u = vs_rulebase(untrained)
+    print(f"untrained vs rulebase: wp {wp_u:.3f} mean outcome {out_u:.3f}", flush=True)
+    wp_t, out_t = vs_rulebase(trained)
+    print(f"trained   vs rulebase: wp {wp_t:.3f} mean outcome {out_t:.3f}", flush=True)
+    verdict = {
+        "wp_untrained": wp_u, "wp_trained": wp_t,
+        "outcome_untrained": out_u, "outcome_trained": out_t,
+        "margin": out_t - out_u,
+        "learns": bool(out_t > out_u + 0.12), "top_half": bool(wp_t >= 0.5),
+    }
+    print("RESULT " + json.dumps(verdict), flush=True)
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "train"
+    {"train": train, "eval": evaluate}[mode]()
