@@ -82,6 +82,64 @@ def _frame_dir(d, color):
     return jnp.where(color == 1, 3 - d, d)
 
 
+def _obs_from_fields(board, kind, counts, ply):
+    """Both players' observation views from the raw state/record fields
+    (board (M, 36), kind (M, 16), counts (M, 2, 2), ply (M,)) — shared by
+    ``observation`` (live state) and ``view_obs_all`` (device-replay
+    reconstruction from compact records), mirroring host observation()
+    (geister.py:291-326): color bit, my-view bit, 4x onehot4 piece counts;
+    7 planes with the opponent's piece types hidden; White sees the board
+    180-degree rotated."""
+    M = board.shape[0]
+    c = (ply % 2).astype(jnp.int32)
+    board = board.astype(jnp.int32)
+    occupied = board >= 0
+    owner = jnp.where(occupied, board // 8, -1)              # (M, 36)
+    ptype = jnp.where(
+        occupied, kind[jnp.arange(M)[:, None], jnp.clip(board, 0, 15)], -1
+    )
+    counts = counts.astype(jnp.int32)
+
+    def onehot4(n):  # (M,) -> (M, 4) for values 1..4
+        return (n[:, None] == jnp.arange(1, 5)[None, :]).astype(jnp.float32)
+
+    scalars, boards = [], []
+    for p in range(NUM_PLAYERS):
+        me, opp = p, 1 - p
+        my_view = (c == p).astype(jnp.float32)
+        scalar = jnp.concatenate(
+            [
+                jnp.full((M, 1), 1.0 if me == 0 else 0.0),
+                my_view[:, None],
+                onehot4(counts[:, me, BLUE]),
+                onehot4(counts[:, me, RED]),
+                onehot4(counts[:, opp, BLUE]),
+                onehot4(counts[:, opp, RED]),
+            ],
+            axis=1,
+        )
+        planes = jnp.stack(
+            [
+                jnp.ones((M, NUM_SQUARES), jnp.float32),
+                (owner == me).astype(jnp.float32),
+                (owner == opp).astype(jnp.float32),
+                ((owner == me) & (ptype == BLUE)).astype(jnp.float32),
+                ((owner == me) & (ptype == RED)).astype(jnp.float32),
+                jnp.zeros((M, NUM_SQUARES), jnp.float32),
+                jnp.zeros((M, NUM_SQUARES), jnp.float32),
+            ],
+            axis=1,
+        )                                                    # (M, 7, 36)
+        if p == 1:  # 180-degree rotation == reversed flat index
+            planes = planes[:, :, ::-1]
+        scalars.append(scalar)
+        boards.append(planes.reshape(M, 7, SIZE, SIZE))
+    return {
+        "scalar": jnp.stack(scalars, axis=1),
+        "board": jnp.stack(boards, axis=1),
+    }
+
+
 class VectorGeister:
     """Stateless namespace of batched transition functions."""
 
@@ -305,54 +363,24 @@ class VectorGeister:
         views mirroring host observation() (geister.py:291-326): color bit,
         my-view bit, 4x onehot4 piece counts; 7 planes with the opponent's
         piece types hidden; White sees the board 180-degree rotated."""
-        B = state["board"].shape[0]
-        c = (state["ply"] % 2).astype(jnp.int32)
-        board = state["board"].astype(jnp.int32)             # (B, 36)
-        occupied = board >= 0
-        owner = jnp.where(occupied, board // 8, -1)          # (B, 36)
-        ptype = jnp.where(
-            occupied, state["kind"][jnp.arange(B)[:, None], jnp.clip(board, 0, 15)], -1
+        return _obs_from_fields(
+            state["board"], state["kind"], state["counts"], state["ply"]
         )
-        counts = state["counts"].astype(jnp.int32)           # (B, 2, 2)
 
-        def onehot4(n):  # (B,) -> (B, 4) for values 1..4
-            return (n[:, None] == jnp.arange(1, 5)[None, :]).astype(jnp.float32)
-
-        scalars, boards = [], []
-        for p in range(NUM_PLAYERS):
-            me, opp = p, 1 - p
-            my_view = (c == p).astype(jnp.float32)
-            scalar = jnp.concatenate(
-                [
-                    jnp.full((B, 1), 1.0 if me == 0 else 0.0),
-                    my_view[:, None],
-                    onehot4(counts[:, me, BLUE]),
-                    onehot4(counts[:, me, RED]),
-                    onehot4(counts[:, opp, BLUE]),
-                    onehot4(counts[:, opp, RED]),
-                ],
-                axis=1,
-            )
-            planes = jnp.stack(
-                [
-                    jnp.ones((B, NUM_SQUARES), jnp.float32),
-                    (owner == me).astype(jnp.float32),
-                    (owner == opp).astype(jnp.float32),
-                    ((owner == me) & (ptype == BLUE)).astype(jnp.float32),
-                    ((owner == me) & (ptype == RED)).astype(jnp.float32),
-                    jnp.zeros((B, NUM_SQUARES), jnp.float32),
-                    jnp.zeros((B, NUM_SQUARES), jnp.float32),
-                ],
-                axis=1,
-            )                                                # (B, 7, 36)
-            if p == 1:  # 180-degree rotation == reversed flat index
-                planes = planes[:, :, ::-1]
-            scalars.append(scalar)
-            boards.append(planes.reshape(B, 7, SIZE, SIZE))
-        return {
-            "scalar": jnp.stack(scalars, axis=1),
-            "board": jnp.stack(boards, axis=1),
-        }
+    @staticmethod
+    def view_obs_all(compact):
+        """Device twin of ``episode_obs``: rebuild BOTH players'
+        {'scalar', 'board'} views from gathered compact records with any
+        leading shape (N, T, ...) — the device-replay sampler's obs
+        reconstruction (unmasked; the sampler applies observation_mask)."""
+        lead = compact["board"].shape[:-1]                   # (N, T)
+        flat = _obs_from_fields(
+            compact["board"].reshape((-1, NUM_SQUARES)),
+            compact["kind"].reshape((-1, 16)),
+            compact["counts"].reshape((-1, 2, 2)),
+            compact["ply"].reshape((-1,)),
+        )
+        return {k: v.reshape(lead + v.shape[1:]) for k, v in flat.items()}
 
     # -- streaming-rollout hooks --------------------------------------------
 

@@ -48,11 +48,19 @@ per-episode acceptance curve (train.py:292-303), and window starts are
 uniform over eligible STEPS, which weights episodes by the number of
 windows they contain rather than uniformly.
 
-Scope (checked at construction): simultaneous-move vector envs with the
-compact-record hooks + a ``view_obs`` device view, feed-forward nets
-(``initial_state() is None``), ``burn_in_steps: 0``,
-``turn_based_training: false`` — the north-star HungryGeese configuration.
-Recurrent/turn-based batches keep the host path.
+Two window modes (checked at construction, dispatched by
+``turn_based_training``):
+
+* ``ff`` (``turn_based_training: false``) — simultaneous-move vector envs
+  with a ``view_obs`` device view, feed-forward nets, ``burn_in_steps: 0``,
+  one target player per window — the north-star HungryGeese configuration
+  (``_sample_batch``).
+* ``turn`` (``turn_based_training: true`` + ``observation: true``) — any
+  vector env with a ``view_obs_all`` device view, all players kept per
+  window (make_batch target_players = all), recurrent nets included:
+  burn-in rows are real earlier steps of the same episode and hidden
+  warms from zeros over them in the train step, so no hidden ring is
+  needed — the Geister DRC flagship configuration (``_sample_batch_turn``).
 """
 
 from __future__ import annotations
@@ -96,25 +104,61 @@ class DeviceReplay:
 
     def __init__(self, venv, module, args: Dict[str, Any], mesh,
                  n_lanes: int, slots: int = 1024):
-        if not getattr(venv, "simultaneous", False) or not hasattr(venv, "record"):
+        name = getattr(venv, "__name__", type(venv).__name__)
+        if not hasattr(venv, "record"):
             raise ValueError(
-                "device_replay needs a simultaneous-move vector env with "
-                f"compact-record streaming hooks; {getattr(venv, '__name__', type(venv).__name__)} lacks them"
+                f"device_replay needs a vector env with compact-record "
+                f"streaming hooks; {name} lacks them"
             )
-        if not hasattr(venv, "view_obs"):
-            raise ValueError(
-                f"device_replay needs {getattr(venv, '__name__', type(venv).__name__)}.view_obs (device-side "
-                "single-player observation reconstruction)"
-            )
-        if module.initial_state((1, 1)) is not None:
-            raise ValueError(
-                "device_replay supports feed-forward nets only; recurrent "
-                "training needs whole-episode windows — use the host path"
-            )
-        if args.get("burn_in_steps", 0) != 0:
-            raise ValueError("device_replay requires burn_in_steps: 0")
         if args.get("turn_based_training", True):
-            raise ValueError("device_replay requires turn_based_training: false")
+            # all-player windows (make_batch target_players = all): the
+            # recurrent/turn-based flagship path (Geister DRC).  Burn-in
+            # warms hidden from zeros exactly like the host train step, so
+            # no hidden ring is needed; window rows before the episode
+            # start reproduce make_batch's pre-window padding.
+            self.mode = "turn"
+            if not args.get("observation", False):
+                raise ValueError(
+                    "device_replay with turn_based_training: true requires "
+                    "observation: true (both players' views recorded; the "
+                    "turn-player-gather batch layout keeps the host path)"
+                )
+            if not hasattr(venv, "view_obs_all"):
+                raise ValueError(
+                    f"device_replay (turn-based) needs {name}.view_obs_all "
+                    "(device-side all-player observation reconstruction)"
+                )
+            min_slots = args.get("burn_in_steps", 0) + args["forward_steps"]
+            if slots <= min_slots:
+                raise ValueError(
+                    f"device_replay_slots must exceed burn_in_steps + "
+                    f"forward_steps = {min_slots}"
+                )
+        else:
+            # single-target-player feed-forward windows (the north-star
+            # HungryGeese configuration)
+            self.mode = "ff"
+            if not getattr(venv, "simultaneous", False):
+                raise ValueError(
+                    "device_replay with turn_based_training: false needs a "
+                    f"simultaneous-move vector env; {name} is turn-based"
+                )
+            if not hasattr(venv, "view_obs"):
+                raise ValueError(
+                    f"device_replay needs {name}.view_obs (device-side "
+                    "single-player observation reconstruction)"
+                )
+            if module.initial_state((1, 1)) is not None:
+                raise ValueError(
+                    "recurrent nets need whole-window hidden warmup — use "
+                    "turn_based_training: true (all-player windows) or the "
+                    "host path"
+                )
+            if args.get("burn_in_steps", 0) != 0:
+                raise ValueError(
+                    "device_replay with turn_based_training: false requires "
+                    "burn_in_steps: 0"
+                )
         dp = mesh.shape.get("dp", 1)
         if n_lanes % dp:
             raise ValueError(f"n_lanes {n_lanes} not divisible by dp axis {dp}")
@@ -282,14 +326,17 @@ class DeviceReplay:
         first train step, not per step)."""
         if self.rings is None:
             return 0
-        return int(jax.device_get(_eligibility(self.rings, self.args["forward_steps"]).sum()))
+        return int(jax.device_get(_eligibility(
+            self.rings, self.args["forward_steps"],
+            self.args.get("burn_in_steps", 0),
+        ).sum()))
 
     # -- sample + train -----------------------------------------------------
 
     def _sample(self, rings, key, batch_size: int):
-        return _sample_batch(
-            rings, key, batch_size, self.venv, self.args, self._sample_debug
-        )
+        fn = _sample_batch_turn if self.mode == "turn" else _sample_batch
+        return fn(rings, key, batch_size, self.venv, self.args,
+                  self._sample_debug)
 
     def sample(self, key, batch_size: int, with_info: bool = False):
         """Eager one-off sampling (tests / inspection).  The production
@@ -367,17 +414,98 @@ def _slot_gsteps(g, S: int):
     return g - 1 - ((g - 1 - s) % S)
 
 
-def _eligibility(rings, forward_steps: int):
+def _eligibility(rings, forward_steps: int, burn_in_steps: int = 0):
     """(B, S) bool — slots that are legal window STARTS: part of a finished
     resident episode, with in-episode index inside the host sampler's
     ``train_start`` range [0, max(0, steps - forward_steps)]
-    (replay.py:124)."""
+    (replay.py:124).  With burn-in the window also reads BACKWARD
+    min(burn_in, idx_in_ep) real steps, so those older slots must still be
+    resident (>= the oldest global step the ring holds) — the one case the
+    forward-only invalidation argument does not cover."""
     S = rings["valid"].shape[1]
     gs = _slot_gsteps(rings["g"], S)[None, :]              # (1, S)
     idx_in_ep = gs - rings["ep_start_g"]                   # (B, S)
     ep_len = rings["ep_end_g"] - rings["ep_start_g"] + 1
     max_start = jnp.maximum(0, ep_len - forward_steps)
-    return rings["valid"] & (idx_in_ep <= max_start)
+    ok = rings["valid"] & (idx_in_ep <= max_start)
+    if burn_in_steps:
+        lookback = jnp.minimum(burn_in_steps, idx_in_ep)
+        ok = ok & (gs - lookback >= rings["g"] - S)
+    return ok
+
+
+# per-step arrays the samplers consume positionally; everything else in the
+# record is an env compact-obs field handed to the obs reconstruction hook
+_RECORD_FIELDS = ("active", "observing", "legal", "action", "prob", "value",
+                  "outcome")
+
+
+def _draw_windows(rings, key, batch_size: int, forward_steps: int,
+                  burn_in: int) -> Dict[str, Any]:
+    """Shared window geometry for both sampling modes: draw eligible
+    train_starts uniformly, derive per-row in-episode indices / liveness
+    over the (burn_in + forward) window, and gather the per-step record
+    arrays.  Rows with ``i_t < 0`` are burn-in underflow (before the
+    episode start); rows with ``post`` are past the episode end."""
+    S = rings["valid"].shape[1]
+    T = burn_in + forward_steps
+
+    ok = _eligibility(rings, forward_steps, burn_in)
+    logits = jnp.where(ok.reshape(-1), 0.0, -jnp.inf)
+    flat = jax.random.categorical(key, logits, shape=(batch_size,))
+    lane = (flat // S).astype(jnp.int32)                   # (N,)
+    slot = (flat % S).astype(jnp.int32)                    # train_start slot
+
+    gs0 = _slot_gsteps(rings["g"], S)[slot]                # (N,) train_start g
+    ep_start = rings["ep_start_g"][lane, slot]
+    ep_end = rings["ep_end_g"][lane, slot]
+    idx0 = gs0 - ep_start                                  # in-episode index
+
+    j = jnp.arange(T, dtype=jnp.int32)                     # (T,)
+    i_t = idx0[:, None] - burn_in + j[None, :]             # (N, T) in-ep index
+    gstep = ep_start[:, None] + i_t                        # (N, T) global step
+    live_b = (i_t >= 0) & (gstep <= ep_end[:, None])       # (N, T)
+    wslots = (slot[:, None] - burn_in + j[None, :]) % S    # (N, T)
+
+    def gather(x):                                         # (B, S, ...) -> (N, T, ...)
+        return x[lane[:, None], wslots]
+
+    rec = rings["rec"]
+    # final outcome lives in the episode's END slot record (younger than
+    # train_start, so resident whenever train_start's valid flag survives)
+    end_slot = (slot + (ep_end - gs0)) % S
+    return {
+        "lane": lane, "slot": slot, "i_t": i_t, "gstep": gstep,
+        "ep_end": ep_end,
+        "ep_len": (ep_end - ep_start + 1).astype(jnp.float32),
+        "live_b": live_b, "live": live_b.astype(jnp.float32),
+        "post": gstep > ep_end[:, None],
+        "active": gather(rec["active"]).astype(jnp.float32),
+        "observing": gather(rec["observing"]).astype(jnp.float32),
+        "prob": gather(rec["prob"]),
+        "value": gather(rec["value"]),
+        "action": gather(rec["action"]),
+        "legal": gather(rec["legal"]),
+        "outcome": rec["outcome"][lane, end_slot],         # (N, P)
+        "compact": {
+            k: gather(v) for k, v in rec.items() if k not in _RECORD_FIELDS
+        },
+    }
+
+
+def _step_returns(venv, gamma: float, w: Dict[str, Any]):
+    """Constant per-step reward and its discounted return-to-go on live
+    rows (_streaming_episode's reverse accumulation in closed form)."""
+    step_reward = float(getattr(venv, "step_reward", 0.0))
+    if not step_reward:
+        zeros = jnp.zeros(w["live"].shape, jnp.float32)
+        return zeros, zeros
+    n_t = (w["ep_end"][:, None] - w["gstep"] + 1).astype(jnp.float32)
+    if gamma == 1.0:
+        ret = step_reward * n_t
+    else:
+        ret = step_reward * (1 - gamma ** n_t) / (1 - gamma)
+    return w["live"] * step_reward, w["live"] * ret
 
 
 def _sample_batch(rings, key, batch_size: int, venv, args: Dict[str, Any],
@@ -385,36 +513,13 @@ def _sample_batch(rings, key, batch_size: int, venv, args: Dict[str, Any],
     """Assemble a (batch_size, T, 1, ...) training batch from the rings —
     the device twin of replay.sample_window + batch.make_batch for the
     simultaneous / feed-forward / single-target-player configuration."""
-    B_l, S = rings["valid"].shape
-    T = args["forward_steps"]
     P = venv.num_players
-    gamma = args["gamma"]
     k_start, k_player = jax.random.split(key)
-
-    ok = _eligibility(rings, T)
-    logits = jnp.where(ok.reshape(-1), 0.0, -jnp.inf)
-    flat = jax.random.categorical(k_start, logits, shape=(batch_size,))
-    lane = (flat // S).astype(jnp.int32)                   # (N,)
-    slot = (flat % S).astype(jnp.int32)
+    w = _draw_windows(rings, k_start, batch_size, args["forward_steps"], 0)
     player = jax.random.randint(k_player, (batch_size,), 0, P)
     if debug is not None:
-        debug.append({"lane": lane, "slot": slot, "player": player})
-
-    gs0 = _slot_gsteps(rings["g"], S)[slot]                # (N,) global start
-    ep_start = rings["ep_start_g"][lane, slot]
-    ep_end = rings["ep_end_g"][lane, slot]
-    idx0 = gs0 - ep_start                                  # in-episode index
-    ep_len = (ep_end - ep_start + 1).astype(jnp.float32)
-
-    j = jnp.arange(T, dtype=jnp.int32)                     # (T,)
-    wslots = (slot[:, None] + j[None, :]) % S              # (N, T)
-    live_b = gs0[:, None] + j[None, :] <= ep_end[:, None]  # (N, T) bool
-    live = live_b.astype(jnp.float32)
-
-    def gather(x):                                         # (B, S, ...) -> (N, T, ...)
-        return x[lane[:, None], wslots]
-
-    rec = rings["rec"]
+        debug.append({"lane": w["lane"], "slot": w["slot"], "player": player})
+    live_b, live = w["live_b"], w["live"]
 
     def pick_player(x):                                    # (N, T, P, ...) -> (N, T)
         idx = player.reshape(-1, 1, 1)
@@ -422,28 +527,18 @@ def _sample_batch(rings, key, batch_size: int, venv, args: Dict[str, Any],
         idx = idx.reshape(idx.shape + (1,) * (x.ndim - 3))
         return jnp.take_along_axis(x, idx, axis=2)[:, :, 0]
 
-    act_p = pick_player(gather(rec["active"]).astype(jnp.float32))     # (N, T)
-    obs_p = pick_player(gather(rec["observing"]).astype(jnp.float32))
-    prob_p = pick_player(gather(rec["prob"]))
-    value_p = pick_player(gather(rec["value"]))
-    action_p = pick_player(gather(rec["action"]))
-    legal_p = pick_player(gather(rec["legal"]))                        # (N, T, A)
-
-    # final outcome lives in the episode's END slot record
-    end_slot = (slot + (ep_end - gs0)) % S
-    outcome_all = rec["outcome"][lane, end_slot]                       # (N, P)
-    outcome_p = jnp.take_along_axis(outcome_all, player[:, None], axis=1)[:, 0]
+    act_p = pick_player(w["active"])                       # (N, T)
+    obs_p = pick_player(w["observing"])
+    prob_p = pick_player(w["prob"])
+    value_p = pick_player(w["value"])
+    action_p = pick_player(w["action"])
+    legal_p = pick_player(w["legal"])                      # (N, T, A)
+    outcome_p = jnp.take_along_axis(w["outcome"], player[:, None], axis=1)[:, 0]
 
     tmask = live * act_p                                   # (N, T)
     omask = live * obs_p
 
-    compact = {
-        k: gather(v)
-        for k, v in rec.items()
-        if k not in ("active", "observing", "legal", "action", "prob",
-                     "value", "outcome")
-    }
-    planes = venv.view_obs(compact, player)                # (N, T, planes, R, C)
+    planes = venv.view_obs(w["compact"], player)           # (N, T, planes, R, C)
     obs = planes * omask[:, :, None, None, None]
     obs = obs[:, :, None]                                  # (N, T, 1, planes, R, C)
 
@@ -451,23 +546,10 @@ def _sample_batch(rings, key, batch_size: int, venv, args: Dict[str, Any],
         legal_p & (tmask[..., None] > 0), 0.0, ILLEGAL
     ).astype(jnp.float32)[:, :, None]                      # (N, T, 1, A)
 
-    # per-step constant reward and its discounted return-to-go
-    # (_streaming_episode's reverse accumulation in closed form)
-    step_reward = float(getattr(venv, "step_reward", 0.0))
-    if step_reward:
-        n_t = (ep_end[:, None] - (gs0[:, None] + j[None, :]) + 1).astype(jnp.float32)
-        if gamma == 1.0:
-            ret = step_reward * n_t
-        else:
-            ret = step_reward * (1 - gamma ** n_t) / (1 - gamma)
-        reward = live * step_reward
-        ret = live * ret
-    else:
-        reward = jnp.zeros((batch_size, T), jnp.float32)
-        ret = reward
+    reward, ret = _step_returns(venv, args["gamma"], w)
 
     progress = jnp.where(
-        live_b, (idx0[:, None] + j[None, :]).astype(jnp.float32) / ep_len[:, None], 1.0
+        live_b, w["i_t"].astype(jnp.float32) / w["ep_len"][:, None], 1.0
     )
 
     exp = lambda x: x[:, :, None, None]                    # (N, T) -> (N, T, 1, 1)
@@ -482,6 +564,70 @@ def _sample_batch(rings, key, batch_size: int, venv, args: Dict[str, Any],
         "episode_mask": exp(live),
         "turn_mask": exp(tmask),
         "observation_mask": exp(omask),
+        "action_mask": amask,
+        "progress": progress[:, :, None],
+    }
+
+
+def _sample_batch_turn(rings, key, batch_size: int, venv, args: Dict[str, Any],
+                       debug: Optional[list] = None) -> Dict[str, Any]:
+    """All-player window assembly — the device twin of sample_window +
+    make_batch for ``turn_based_training: true`` with ``observation: true``
+    (batch.py:62-93, target_players = all): actor- and target-side arrays
+    both keep every player, windows span burn_in + forward_steps rows with
+    the host's three padding regions (zeros/fills before the episode
+    start, live data inside, outcome-frozen fills past the end).  Burn-in
+    rows are REAL earlier steps of the same episode (start = max(0,
+    train_start - burn_in), replay.py:125) — hidden warms from zeros over
+    them under stop_gradient in the train step, so no hidden ring is
+    stored."""
+    burn_in = args.get("burn_in_steps", 0)
+    T = burn_in + args["forward_steps"]
+    P = venv.num_players
+
+    w = _draw_windows(rings, key, batch_size, args["forward_steps"], burn_in)
+    if debug is not None:
+        debug.append({"lane": w["lane"], "slot": w["slot"],
+                      "player": jnp.full((batch_size,), -1, jnp.int32)})
+    live_b, live, outcome = w["live_b"], w["live"], w["outcome"]
+
+    act = live[..., None] * w["active"]                    # (N, T, P)
+    obsv = live[..., None] * w["observing"]
+
+    planes = venv.view_obs_all(w["compact"])               # leaves (N, T, P, ...)
+    obs = tree_map(
+        lambda x: x * obsv.reshape(obsv.shape + (1,) * (x.ndim - 3)), planes
+    )
+
+    amask = jnp.where(
+        w["legal"] & (act[..., None] > 0), 0.0, ILLEGAL
+    ).astype(jnp.float32)                                  # (N, T, P, A)
+
+    reward, ret = _step_returns(venv, args["gamma"], w)
+    per_p = lambda x: jnp.broadcast_to(x[:, :, None, None], (batch_size, T, P, 1))
+
+    # value: live rows carry the recorded estimate (x observing), rows past
+    # the end freeze at the outcome, burn-in underflow rows are 0
+    value_b = jnp.where(
+        live_b[..., None], w["value"] * obsv,
+        jnp.where(w["post"][..., None], outcome[:, None, :], 0.0),
+    )
+
+    progress = jnp.where(
+        live_b, w["i_t"].astype(jnp.float32) / w["ep_len"][:, None], 1.0
+    )
+
+    return {
+        "observation": obs,
+        "selected_prob": jnp.where(act > 0, w["prob"], 1.0)[..., None],
+        "value": value_b[..., None],
+        "action": jnp.where(act > 0, w["action"], 0).astype(jnp.int32)[..., None],
+        "outcome": outcome[:, None, :, None],
+        "reward": per_p(reward),
+        "return": per_p(ret),
+        "episode_mask": live[:, :, None, None],
+        "turn_mask": act[..., None],
+        "observation_mask": obsv[..., None],
         "action_mask": amask,
         "progress": progress[:, :, None],
     }

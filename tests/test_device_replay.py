@@ -27,18 +27,17 @@ N_CALLS = 10          # 320 steps > SLOTS: the ring wraps and invalidation runs
 SLOTS = 192
 
 
-def _args(env_name: str = "HungryGeese"):
+def _args(env_name: str = "HungryGeese", **overrides):
+    train = {
+        "turn_based_training": False,
+        "observation": False,
+        "batch_size": 8,
+        "forward_steps": 8,
+        "burn_in_steps": 0,
+    }
+    train.update(overrides)
     cfg = normalize_args(
-        {
-            "env_args": {"env": env_name},
-            "train_args": {
-                "turn_based_training": False,
-                "observation": False,
-                "batch_size": 8,
-                "forward_steps": 8,
-                "burn_in_steps": 0,
-            },
-        }
+        {"env_args": {"env": env_name}, "train_args": train}
     )
     args = dict(cfg["train_args"])
     args["env"] = cfg["env_args"]
@@ -46,18 +45,18 @@ def _args(env_name: str = "HungryGeese"):
 
 
 def _drive_rollout(env_name: str, venv, n_lanes: int, k_steps: int,
-                   n_calls: int, slots: int):
+                   n_calls: int, slots: int, **arg_overrides):
     """Drive the streaming fn once; return the host episodes (with their
     [lane, g0, g1] global-step spans) and a DeviceReplay holding the SAME
     records — the two sides every parity check compares."""
     env = make_env({"env": env_name})
     module = env.net()
     params = init_variables(module, env)["params"]
-    args = _args(env_name)
+    args = _args(env_name, **arg_overrides)
 
     mesh = make_mesh({"dp": 1})
     fn = build_streaming_fn(venv, module, n_lanes, k_steps, mesh=None,
-                            use_observe_mask=False)
+                            use_observe_mask=bool(args["observation"]))
     replay = DeviceReplay(venv, module, args, mesh, n_lanes, slots=slots)
 
     state = venv.init(n_lanes, jax.random.PRNGKey(7))
@@ -99,10 +98,10 @@ def rollout_data():
 
 def _host_window(ep, train_start, args):
     """Reconstruct the exact sample_window dict (replay.py:110-140) for a
-    forced train_start (burn_in 0: start == train_start)."""
+    forced train_start."""
     fwd, cs = args["forward_steps"], args["compress_steps"]
     steps = ep["steps"]
-    start = train_start
+    start = max(0, train_start - args["burn_in_steps"])
     end = min(train_start + fwd, steps)
     first_block = start // cs
     last_block = (end - 1) // cs + 1
@@ -140,19 +139,21 @@ def _check_windows(data, monkeypatch, n: int, seed: int = 3):
         train_start = gs0 - g0
         assert train_start <= max(0, ep["steps"] - args["forward_steps"])
 
-        monkeypatch.setattr(
-            "handyrl_tpu.runtime.batch.random.randrange", lambda _n: player
-        )
+        if player >= 0:  # ff mode samples one target player per window
+            monkeypatch.setattr(
+                "handyrl_tpu.runtime.batch.random.randrange", lambda _n: player
+            )
         host = make_batch([_host_window(ep, train_start, args)], args)
 
         for key in host:
-            dev = batch[key][i : i + 1]
-            if key == "observation":
-                for hl, dl in zip(jax.tree.leaves(host[key]), jax.tree.leaves(dev)):
-                    np.testing.assert_allclose(dl, hl, atol=1e-6, err_msg=key)
+            if key == "observation":  # pytree for some envs (Geister)
+                for hl, dl in zip(jax.tree.leaves(host[key]), jax.tree.leaves(batch[key])):
+                    np.testing.assert_allclose(
+                        dl[i : i + 1], hl, atol=1e-6, err_msg=f"{key} row {i}"
+                    )
             else:
                 np.testing.assert_allclose(
-                    dev, host[key], atol=1e-6, err_msg=f"{key} row {i}"
+                    batch[key][i : i + 1], host[key], atol=1e-6, err_msg=f"{key} row {i}"
                 )
 
 
@@ -169,6 +170,48 @@ def test_parallel_tictactoe_device_replay_parity(monkeypatch):
     data = _drive_rollout("ParallelTicTacToe", VectorParallelTicTacToe,
                           n_lanes=4, k_steps=12, n_calls=6, slots=32)
     _check_windows(data, monkeypatch, n=32)
+
+
+@pytest.fixture(scope="module")
+def geister_rollout_data():
+    """The turn-based + recurrent mode: VectorGeister with the DRC net,
+    observation: true (both players' views + observer omask), burn-in 4."""
+    from handyrl_tpu.envs.vector_geister import VectorGeister
+
+    # random Geister games mostly reach the 200-ply draw, so each lane
+    # needs ~700 steps to finish >=3 episodes
+    return _drive_rollout(
+        "Geister", VectorGeister, n_lanes=4, k_steps=32, n_calls=22,
+        slots=256, turn_based_training=True, observation=True,
+        burn_in_steps=4,
+    )
+
+
+@pytest.mark.slow  # ~3 min of jitted DRC rollout on the CPU mesh
+def test_geister_turn_windows_match_make_batch(geister_rollout_data, monkeypatch):
+    """Turn-mode device windows (all players, burn-in rows, DRC records)
+    must equal make_batch key by key on the same episode + train_start."""
+    _check_windows(geister_rollout_data, monkeypatch, n=32)
+
+
+@pytest.mark.slow
+def test_geister_turn_train_fn_runs(geister_rollout_data):
+    """Recurrent sample+SGD straight from the rings: the train step's RNN
+    scan consumes the device-assembled (B, T, P, ...) window (burn-in under
+    stop_gradient) — finite loss, params move."""
+    data = geister_rollout_data
+    ctx = TrainContext(data["module"], data["args"], data["mesh"])
+    state = ctx.init_state(data["params"])
+    before = jax.device_get(state["params"])
+    fn = data["replay"].train_fn(ctx, fused_steps=1)
+    state, metrics = fn(state, jax.random.PRNGKey(11), 1e-3)
+    m = jax.device_get(metrics)
+    assert np.isfinite(m["total"]) and m["dcnt"] > 0
+    after = jax.device_get(state["params"])
+    assert max(
+        float(np.abs(a - b).max())
+        for a, b in zip(jax.tree.leaves(after), jax.tree.leaves(before))
+    ) > 0, "params did not move"
 
 
 def test_eligibility_and_wrap(rollout_data):
@@ -266,6 +309,50 @@ def test_learner_device_replay_end_to_end(tmp_path, monkeypatch):
     assert any(r.get("device_mean_episode_len", 0) > 1 for r in records)
     assert os.path.exists("models/latest.ckpt")
     assert os.path.exists("models/state.ckpt")
+    assert learner.trainer.store.total_added == 0, (
+        "device_replay must not materialize host episodes"
+    )
+
+
+@pytest.mark.slow
+def test_learner_geister_device_replay_end_to_end(tmp_path, monkeypatch):
+    """Full --train stack on the turn-based + recurrent mode: Geister DRC
+    trained from device rings (burn-in windows, all-player batches), no
+    host episodes materialized, epochs advance, checkpoints land."""
+    import json
+    import os
+
+    from handyrl_tpu.runtime.learner import Learner
+
+    monkeypatch.chdir(tmp_path)
+    cfg = normalize_args({
+        "env_args": {"env": "Geister"},
+        "train_args": {
+            "turn_based_training": True,
+            "observation": True,
+            "batch_size": 4,
+            "forward_steps": 4,
+            "burn_in_steps": 2,
+            "minimum_episodes": 2,
+            "update_episodes": 2,
+            "maximum_episodes": 100,
+            "epochs": 1,
+            "eval_rate": 0.0,
+            "device_rollout_games": 2,
+            "device_replay": True,
+            "device_replay_slots": 256,
+            "device_replay_k_steps": 64,
+            "mesh": {"dp": 1},
+            "worker": {"num_parallel": 1},
+        },
+    })
+    learner = Learner(cfg)
+    learner.run()
+
+    records = [json.loads(l) for l in open("metrics.jsonl")]
+    assert len(records) == 1
+    assert records[-1]["steps"] > 0, "no SGD updates ran"
+    assert os.path.exists("models/latest.ckpt")
     assert learner.trainer.store.total_added == 0, (
         "device_replay must not materialize host episodes"
     )
