@@ -18,15 +18,19 @@ ring ingest and SGD all on device; host workers eval-only.  Artifacts land in
 Phase 2 (subprocess, CPU-pinned): matched 240-game evals — the trained net and
 the SAME net untrained, each vs 3 greedy rule-based seats
 (envs/hungry_geese.py rule_based_action) — identical margin calibration to the
-committed soak: mean-outcome difference se <= 0.068, +0.12 margin.
+committed soak: mean-outcome difference se <= 0.068, +0.12 margin.  The
+verdict drives the exit code (tools/_soak_tpu_common.py).
+
+Result 2026-07-31 (TPU v5 lite x1): wp 0.531 -> 0.733, mean outcome
+-0.221 -> +0.110 — 4,944 updates / 100,500 episodes in ~17 min.
 """
 
-import json
 import os
-import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools._soak_tpu_common import run  # noqa: E402
 
 RUN_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                        "soak_geese_tpu_run")
@@ -57,61 +61,6 @@ CFG = {
     },
 }
 
-
-def train() -> None:
-    os.makedirs(RUN_DIR, exist_ok=True)
-    os.chdir(RUN_DIR)
-    from handyrl_tpu.config import normalize_args
-    from handyrl_tpu.runtime.learner import Learner
-
-    import jax
-    d = jax.devices()[0]
-    print(f"platform: {d.platform}:{getattr(d, 'device_kind', '?')}", flush=True)
-    Learner(normalize_args(CFG)).run()
-    print("training done; launching CPU-pinned matched eval", flush=True)
-    # the eval subprocess pins CPU itself (jax.config in evaluate());
-    # its verdict is the run's whole point, so its failure is ours
-    rc = subprocess.run([sys.executable, os.path.abspath(__file__), "eval"],
-                        check=False).returncode
-    if rc != 0:
-        print(f"matched eval FAILED (rc={rc})", flush=True)
-    sys.exit(rc)
-
-
-def evaluate() -> None:
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    from handyrl_tpu.agents import Agent
-    from handyrl_tpu.config import normalize_args
-    from handyrl_tpu.envs import make_env
-    from handyrl_tpu.models import InferenceModel, init_variables
-    from handyrl_tpu.runtime.evaluation import eval_vs_baseline, load_model_agent
-
-    args = normalize_args(CFG)
-    env_args = args["env_args"]
-    env = make_env(env_args)
-    module = env.net()
-
-    def vs_rulebase(agent0, num_games=240):
-        return eval_vs_baseline(env_args, agent0, "rulebase", num_games,
-                                num_workers=4)
-
-    untrained = Agent(InferenceModel(module, init_variables(module, env)))
-    trained = load_model_agent(os.path.join(RUN_DIR, "models", "latest.ckpt"),
-                               env, module)
-    wp_u, out_u = vs_rulebase(untrained)
-    print(f"untrained vs rulebase: wp {wp_u:.3f} mean outcome {out_u:.3f}", flush=True)
-    wp_t, out_t = vs_rulebase(trained)
-    print(f"trained   vs rulebase: wp {wp_t:.3f} mean outcome {out_t:.3f}", flush=True)
-    verdict = {
-        "wp_untrained": wp_u, "wp_trained": wp_t,
-        "outcome_untrained": out_u, "outcome_trained": out_t,
-        "margin": out_t - out_u,
-        "learns": bool(out_t > out_u + 0.12), "top_half": bool(wp_t >= 0.5),
-    }
-    print("RESULT " + json.dumps(verdict), flush=True)
-
-
 if __name__ == "__main__":
-    mode = sys.argv[1] if len(sys.argv) > 1 else "train"
-    {"train": train, "eval": evaluate}[mode]()
+    run(sys.argv, os.path.abspath(__file__), CFG, RUN_DIR,
+        opponent="rulebase", margin=0.12, wp_bar=0.5)
