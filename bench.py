@@ -714,6 +714,40 @@ def _device_replay_northstar_bench(train_res, duration: float,
     }
 
 
+def _geister_device_replay_bench(duration: float):
+    """Turn-mode device-resident replay (runtime/device_replay.py turn
+    mode): Geister's DRC ConvLSTM trained straight from device rings —
+    all-player windows with 4 real burn-in rows + UPGO — concurrent with
+    turn-based streaming self-play, same loop shape as northstar2.  The
+    on-chip soak this measures the steady state of trained wp 0.519->0.694
+    vs random in ~10 min (BASELINE.md)."""
+    from types import SimpleNamespace
+
+    import jax
+
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.models import init_variables
+    from handyrl_tpu.parallel import TrainContext, make_mesh
+
+    args = _make_args(
+        "Geister",
+        {"turn_based_training": True, "observation": True,
+         "batch_size": 16, "forward_steps": 8, "burn_in_steps": 4,
+         "policy_target": "UPGO", "value_target": "UPGO"},
+    )
+    n_devices = len(jax.devices())
+    if args["batch_size"] % n_devices:  # same guard as _train_bench
+        args["batch_size"] = max(n_devices, args["batch_size"] // n_devices * n_devices)
+    env = make_env(args["env"])
+    module = env.net()
+    ctx = TrainContext(module, args, make_mesh(args["mesh"]))
+    train_res = {"args": args, "ctx": ctx, "module": module,
+                 "model": SimpleNamespace(variables=init_variables(module, env))}
+    return _device_replay_northstar_bench(
+        train_res, duration, n_lanes=64, k_steps=32, fused_steps=4
+    )
+
+
 def _flash_attention_bench(duration: float = 3.0):
     """Masked Pallas flash kernel vs exact einsum on the transformer
     seq-mode semantics (fwd+bwd), at a long-window shape where the O(T^2)
@@ -983,6 +1017,32 @@ def main() -> None:
             result["extra"]["geister_device_selfplay_episodes_note"] = gsd["episodes_note"]
     except Exception:
         result["error"] = (result["error"] or "") + " geister-device-selfplay: " + traceback.format_exc(limit=3)
+
+    # 4c. turn-mode device-resident replay: Geister DRC trained straight
+    # from device rings (all-player burn-in windows, runtime/device_replay
+    # turn mode) concurrent with streaming self-play — TPU-gated: on CPU
+    # the DRC window compile dominates any timed window
+    try:
+        import jax
+
+        if jax.default_backend() == "tpu":
+            gdr = _geister_device_replay_bench(T_TRAIN)
+            if "skipped" in gdr:  # benign prefill timeout, like stage 3d
+                result["extra"]["geister_devreplay_note"] = gdr["skipped"]
+            else:
+                result["extra"]["geister_devreplay_updates_per_sec"] = _sig(
+                    gdr["updates_per_sec"]
+                )
+                result["extra"]["geister_devreplay_trained_env_steps_per_sec"] = _sig(
+                    gdr["trained_env_steps_per_sec"], 5
+                )
+                result["extra"]["geister_devreplay_selfplay_env_steps_per_sec"] = _sig(
+                    gdr["selfplay_env_steps_per_sec"]
+                )
+                if not gdr["loss_finite"]:
+                    result["error"] = (result["error"] or "") + " geister-devreplay: non-finite loss"
+    except Exception:
+        result["error"] = (result["error"] or "") + " geister-devreplay: " + traceback.format_exc(limit=3)
 
     # 5. seq-attention kernel crossover (einsum vs Pallas flash, fwd+bwd)
     try:
