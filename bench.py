@@ -60,10 +60,54 @@ def _note(msg: str) -> None:
     """Progress marker on stderr (stdout stays one JSON line)."""
     import sys
 
+    global _LAST_NOTE
+    _LAST_NOTE = msg
     print(f"[bench +{time.perf_counter() - _T0:.0f}s] {msg}", file=sys.stderr, flush=True)
 
 
 _T0 = time.perf_counter()
+_LAST_NOTE = "startup"
+
+
+def _start_watchdog(result: dict, done: "threading.Event") -> None:
+    """A single wedged device dispatch must not cost the whole capture: a
+    tunneled TPU call can block forever (observed mid-run, 2026-07-31 —
+    the same failure mode the init-time probe sentinel already guards).
+    If the run exceeds BENCH_WATCHDOG_S (default 45 min; 0 disables), the
+    watchdog prints the result JSON accumulated SO FAR with an explicit
+    error naming the wedged stage, then hard-exits.  os._exit aborts the
+    in-flight XLA call, which can wedge the chip lease — acceptable only
+    because a lease stuck under a hung dispatch is already lost to this
+    process, and a partial capture beats none."""
+    try:
+        budget = float(os.environ.get("BENCH_WATCHDOG_S", "2700") or 0)
+    except ValueError:  # malformed override must not cost the JSON contract
+        budget = 2700.0
+    if budget <= 0:
+        return
+
+    def fire():
+        if done.wait(budget) or done.is_set():
+            return  # normal completion (re-checked: main prints exactly once)
+        import sys
+
+        msg = f" watchdog: run exceeded {budget:.0f}s; wedged at stage: {_LAST_NOTE}"
+        try:
+            try:
+                # snapshot: main may still be mutating result on a slow run
+                snap = dict(result)
+                snap["extra"] = dict(result.get("extra") or {})
+                snap["error"] = (snap.get("error") or "") + msg
+                print(json.dumps(snap, default=str))
+            except Exception:  # racing mutation: still honor the JSON contract
+                print(json.dumps({"metric": result.get("metric"), "value": None,
+                                  "unit": "env-steps/s", "vs_baseline": None,
+                                  "error": msg}))
+            sys.stdout.flush()
+        finally:
+            os._exit(0)
+
+    threading.Thread(target=fire, daemon=True).start()
 
 
 def _probe_accelerator(timeout: float = 120.0) -> Optional[tuple]:
@@ -801,10 +845,14 @@ def main() -> None:
         "extra": {},
     }
 
+    done = threading.Event()
+    _start_watchdog(result, done)
+
     devices, backend_err = _devices_with_retry()
     if backend_err:
         result["error"] = str(backend_err)
     if devices is None:
+        done.set()
         print(json.dumps(result))
         return
     result["platform"] = f"{devices[0].platform}:{getattr(devices[0], 'device_kind', '?')} x{len(devices)}"
@@ -1053,6 +1101,7 @@ def main() -> None:
     except Exception:
         result["error"] = (result["error"] or "") + " flash: " + traceback.format_exc(limit=3)
 
+    done.set()
     print(json.dumps(result))
 
 
