@@ -277,3 +277,35 @@ def test_lr_scale_multiplies_reference_schedule():
     lr0 = scaled.lr
     scaled.steps = 1000
     assert scaled.lr == pytest.approx(lr0 / (1 + 1000 * 1e-5))
+
+
+def test_jaxpr_flops_close_to_hlo():
+    """The backend-free analytic counter (flops_per_step fallback 3) must
+    track XLA:CPU's HLO 'flops' — it substitutes for it when the platform
+    list is pinned to a plugin with no cost model (axon TPU)."""
+    import jax.numpy as jnp
+
+    from handyrl_tpu.parallel.train_step import jaxpr_flops
+
+    targs = _args("TicTacToe", batch_size=4, forward_steps=8)
+    env, module, model, eps = _gen_episodes("TicTacToe", 6, targs, seed=5)
+    store = EpisodeStore(100)
+    store.extend(eps)
+    mesh = make_mesh({"dp": 1})
+    ctx = TrainContext(module, targs, mesh)
+    state = ctx.init_state(model.variables["params"])
+    batch = ctx.put_batch(
+        make_batch([store.sample_window(8, 0, 4) for _ in range(4)], targs)
+    )
+    # the HLO reference must come from a REAL cost model — flops_per_step
+    # falls back to jaxpr_flops itself, which would make this vacuous
+    ca = ctx._bind(state).lower(state, batch, jnp.float32(1e-5)).cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    hlo = float(ca.get("flops", 0.0)) if ca else 0.0
+    if hlo <= 0:
+        pytest.skip("backend reports no HLO flops; nothing to compare against")
+    analytic = jaxpr_flops(
+        jax.make_jaxpr(ctx._step_fn)(state, batch, jnp.float32(1e-5)).jaxpr
+    )
+    assert 0.5 < analytic / hlo < 2.0, (analytic, hlo)
