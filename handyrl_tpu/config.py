@@ -66,11 +66,15 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     # true: keep the self-play data on device end to end — rollout records
     # are ingested into device ring buffers and training batches are
     # sampled + assembled + stepped in one dispatch (runtime/
-    # device_replay.py).  Needs device_rollout_games > 0, a simultaneous
-    # vector env with the view_obs hook, a feed-forward net,
-    # burn_in_steps 0 and turn_based_training false (the north-star
-    # HungryGeese configuration); other configs keep the host replay.
+    # device_replay.py).  Needs device_rollout_games > 0; two window
+    # modes picked by turn_based_training (see docs/parameters.md).
     "device_replay": False,
+    # N > 0: play N batched net-vs-baseline eval matches ON DEVICE at
+    # every epoch boundary (runtime/device_eval.py) — the per-epoch
+    # win-rate curve host eval workers starve on slow hosts.  Opponent
+    # follows eval.opponent when it is random/rulebase (envs without a
+    # rule_based_action_all device twin fall back to random).
+    "device_eval_games": 0,
     # ring length in steps per lane for device_replay
     "device_replay_slots": 1024,
     # game steps advanced per rollout dispatch in the device_replay loop
@@ -129,6 +133,8 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError("train_args.fused_steps must be >= 1")
     if train["device_rollout_games"] < 0:
         raise ValueError("train_args.device_rollout_games must be >= 0")
+    if train["device_eval_games"] < 0:
+        raise ValueError("train_args.device_eval_games must be >= 0")
     if train["device_replay"]:
         if train["device_rollout_games"] <= 0:
             raise ValueError(

@@ -66,6 +66,17 @@ for _c in range(NUM_CELLS):
 TRANS = jnp.asarray(_trans)
 OPPOSITE = jnp.asarray([1, 0, 3, 2], jnp.int32)
 
+# DIST[a, b] -> torus manhattan distance (host _torus_dist)
+_dist = np.zeros((NUM_CELLS, NUM_CELLS), np.int32)
+for _a in range(NUM_CELLS):
+    _ar, _ac = divmod(_a, COLS)
+    for _b in range(NUM_CELLS):
+        _br, _bc = divmod(_b, COLS)
+        _dist[_a, _b] = min((_ar - _br) % ROWS, (_br - _ar) % ROWS) + min(
+            (_ac - _bc) % COLS, (_bc - _ac) % COLS
+        )
+DIST = jnp.asarray(_dist)
+
 
 def _onehot_cell(cell):
     """one_hot over board cells; -1 (absent) maps to all zeros."""
@@ -298,6 +309,40 @@ class VectorHungryGeese:
         legal-but-lethal, host legal_actions: envs/hungry_geese.py:201-202)."""
         B, P = state["active"].shape
         return jnp.ones((B, P, 4), bool)
+
+    @staticmethod
+    def rule_based_action_all(state, key):
+        """(B, P) greedy food-seeker for every seat — device twin of the
+        host ``rule_based_action`` (hungry_geese.py greedy: step toward
+        the nearest food by torus manhattan distance, never reverse,
+        avoid every goose cell; first direction wins ties, matching the
+        host's strict-< scan over d in 0..3).  Boxed in -> uniform random
+        non-reverse, like the host's random.choice branch.  Powers the
+        on-device evaluator (runtime/device_eval.py)."""
+        B, P = state["active"].shape
+        head = VectorHungryGeese.head_cell(state)            # (B, P)
+        occ_any = state["occ"].sum(axis=1) > 0               # (B, C)
+        food = state["food"] > 0                             # (B, C)
+        nxt = TRANS[jnp.clip(head, 0, NUM_CELLS - 1)]        # (B, P, 4)
+        last = state["last_action"]                          # (B, P)
+        dirs = jnp.arange(4, dtype=jnp.int32)
+        reverse = (last >= 0)[..., None] & (
+            dirs == OPPOSITE[jnp.clip(last, 0, 3)][..., None]
+        )                                                    # (B, P, 4)
+        lane = jnp.arange(B, dtype=jnp.int32)[:, None, None]
+        blocked = occ_any[lane, nxt]                         # (B, P, 4)
+        big = jnp.float32(1e9)
+        fdist = jnp.where(
+            food[:, None, None, :], DIST[nxt].astype(jnp.float32), big
+        ).min(axis=-1)                                       # (B, P, 4)
+        # host: min(..., default=0) — no food makes every dir distance 0
+        fdist = jnp.where(food.any(axis=-1)[:, None, None], fdist, 0.0)
+        valid = ~reverse & ~blocked
+        best = jnp.argmin(jnp.where(valid, fdist, big), axis=-1)
+        boxed = ~valid.any(axis=-1)                          # (B, P)
+        g = jax.random.gumbel(key, (B, P, 4))
+        rnd = jnp.argmax(jnp.where(reverse, -big, g), axis=-1)
+        return jnp.where(boxed, rnd, best).astype(jnp.int32)
 
     @staticmethod
     def record(state):

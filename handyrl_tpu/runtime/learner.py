@@ -166,6 +166,38 @@ class Learner:
                     mesh=self.trainer.ctx.mesh,
                 )
 
+        # on-device evaluation (runtime/device_eval.py): batched
+        # net-vs-baseline matches at every epoch boundary — the per-epoch
+        # win-rate curve that host eval workers starve on 1-core hosts
+        # (both round-3 soaks recorded NaN/sparse curves)
+        self._device_eval = None
+        n_eval = int(self.args.get("device_eval_games", 0))
+        if n_eval > 0:
+            vector_env = getattr(self.env, "vector_env", None)
+            if vector_env is None:
+                raise ValueError(
+                    f"device_eval_games set but env "
+                    f"{args['env_args'].get('env')} exposes no vector_env()"
+                )
+            venv = vector_env()
+            opp_list = self.args.get("eval", {}).get("opponent") or ["random"]
+            if not isinstance(opp_list, list):  # same coercion as Evaluator
+                opp_list = [opp_list]
+            opp = opp_list[0]
+            if opp not in ("random", "rulebase") or (
+                opp == "rulebase" and not hasattr(venv, "rule_based_action_all")
+            ):
+                opp = "random"
+            from .device_eval import DeviceEvaluator
+
+            mesh = self.trainer.ctx.mesh
+            lanes = min(64, max(8, n_eval))
+            dp = mesh.shape.get("dp", 1)
+            lanes = max(dp, lanes - lanes % dp)
+            self._device_eval = DeviceEvaluator(
+                venv, self.module, n_lanes=lanes, opponent=opp, mesh=mesh,
+            )
+
     # -- request plumbing ---------------------------------------------------
 
     def handle(self, req: str, data: Any, timeout: Optional[float] = None) -> Any:
@@ -212,10 +244,34 @@ class Learner:
         mean = r / (n + 1e-6)
         return (mean + 1) / 2, n
 
+    def _feed_device_eval(self) -> None:
+        """Batched on-device matches with the current snapshot, filed into
+        the same books as worker eval results (so _win_rate and the
+        metrics.jsonl win_rate curve see them unchanged)."""
+        import jax
+
+        epoch, params = self.model_server.latest_snapshot()
+        key = jax.random.PRNGKey(self.args["seed"] + 0xE7A1 + self.model_epoch)
+        counts = self._device_eval.evaluate(
+            params, int(self.args["device_eval_games"]), key
+        )
+        opponent = "device-" + self._device_eval.opponent
+        self.feed_results([
+            {"args": {"player": [0], "model_id": {0: epoch}},
+             "result": {0: outcome}, "opponent": opponent}
+            for outcome, n in counts.items() for _ in range(n)
+        ])
+
     def update(self) -> None:
         print()
         print("epoch %d" % self.model_epoch)
         record: Dict[str, Any] = {"epoch": self.model_epoch}
+
+        if self._device_eval is not None:
+            try:
+                self._feed_device_eval()
+            except Exception as exc:  # eval must never kill the boundary
+                print(f"device eval failed: {type(exc).__name__}: {exc}")
 
         if self.model_epoch not in self.results:
             print("win rate = Nan (0)")
