@@ -101,6 +101,23 @@ def test_device_evaluator_counts_and_balance():
     assert sorted(set(seats.tolist())) == [0, 1, 2, 3]
 
 
+def test_device_evaluator_geister_recurrent():
+    """The same evaluator drives turn-based + recurrent envs: Geister's
+    DRC net vs legal-masked random, hidden advancing for both seats every
+    step (the host Agent's observation=True behavior)."""
+    from handyrl_tpu.envs.vector_geister import VectorGeister
+
+    env = make_env({"env": "Geister"})
+    module = env.net()
+    params = init_variables(module, env)["params"]
+    ev = DeviceEvaluator(VectorGeister, module, n_lanes=8, opponent="random",
+                         k_steps=64)
+    counts = ev.evaluate(params, 8, jax.random.PRNGKey(2), max_calls=8)
+    games = sum(counts.values())
+    assert games >= 8
+    assert all(o in (-1.0, 0.0, 1.0) for o in counts), counts
+
+
 def test_eval_stream_fn_rejects_unknown_opponent():
     env = make_env({"env": "HungryGeese"})
     module = env.net()

@@ -54,6 +54,9 @@ CFG = {
         "eval_rate": 0.0,          # workers are eval-only under device_replay
         "device_rollout_games": 64,
         "device_replay": True,
+        # dense per-epoch curve vs the rule-based twin — the host worker's
+        # curve starved on this run's first capture (runtime/device_eval.py)
+        "device_eval_games": 32,
         "fused_steps": 4,          # amortize tunnel RTT: 4 updates/dispatch
         "mesh": {"dp": 1},
         "worker": {"num_parallel": 1},

@@ -60,6 +60,9 @@ CFG = {
         "device_replay": True,
         "device_replay_slots": 512,   # > max episode length 202 + window
         "device_replay_k_steps": 32,
+        # dense per-epoch curve vs device random (Geister has no rule-based
+        # device twin); the host worker's curve starved on the first capture
+        "device_eval_games": 32,
         "fused_steps": 4,
         "mesh": {"dp": 1},
         "worker": {"num_parallel": 1},
