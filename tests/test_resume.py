@@ -89,3 +89,49 @@ def test_learner_resume_continues_steps(tmp_path, monkeypatch):
     records = [json.loads(l) for l in open("metrics.jsonl")]
     assert "input_wait_frac" in records[-1]
     assert "train_steps_per_sec" in records[-1]
+
+
+@pytest.mark.slow
+def test_learner_resume_device_replay(tmp_path, monkeypatch):
+    """Resume works in device_replay mode: the rings are ephemeral (they
+    refill from fresh self-play) but the train state round-trips — a
+    restarted run continues from the checkpointed step count and keeps
+    training with zero host episodes."""
+    from handyrl_tpu.runtime.learner import Learner
+
+    def _args(extra=None):
+        return normalize_args({
+            "env_args": {"env": "HungryGeese"},
+            "train_args": {
+                "turn_based_training": False,
+                "observation": False,
+                "batch_size": 8,
+                "forward_steps": 8,
+                "minimum_episodes": 10,
+                "update_episodes": 40,
+                "maximum_episodes": 1000,
+                "epochs": 1,
+                "eval_rate": 0.0,
+                "device_rollout_games": 8,
+                "device_replay": True,
+                "device_replay_slots": 256,
+                "device_replay_k_steps": 16,
+                "worker": {"num_parallel": 1},
+                **(extra or {}),
+            },
+        })
+
+    monkeypatch.chdir(tmp_path)
+    learner = Learner(_args())
+    learner.run()
+    steps_before = learner.trainer.steps
+    assert steps_before > 0
+    assert learner.trainer.store.total_added == 0
+
+    resumed = Learner(_args({"restart_epoch": 1, "epochs": 3}))
+    assert 0 < resumed.trainer.steps <= steps_before
+    resumed.run()
+    assert resumed.trainer.steps > steps_before
+    assert resumed.trainer.store.total_added == 0, (
+        "resumed device_replay run must not materialize host episodes"
+    )
