@@ -23,8 +23,10 @@ null+note, never a silent 0.0.
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"} plus
 "extra" with the geese numbers.  Never exits non-zero for backend trouble:
-the TPU init is retried, falls back to CPU, and unrecoverable failures
-still print the JSON with an "error" field.
+a wedged chip lease is waited out (re-probe loop, BENCH_TPU_WAIT budget,
+default 30 min) before the CPU fallback, each stage retries once on a
+transient failure, and unrecoverable failures still print the JSON with
+an "error" field.
 """
 
 from __future__ import annotations
@@ -69,7 +71,23 @@ _T0 = time.perf_counter()
 _LAST_NOTE = "startup"
 
 
-def _start_watchdog(result: dict, done: "threading.Event") -> None:
+def _env_float(name: str, default: float) -> float:
+    """Env override parsed as float; a malformed value falls back to the
+    default rather than costing the capture/JSON contract."""
+    try:
+        return float(os.environ.get(name, str(default)) or 0)
+    except ValueError:
+        return default
+
+
+def _tpu_wait_budget() -> float:
+    """Seconds the init-time probe may spend waiting out a wedged chip
+    lease before the CPU fallback (BENCH_TPU_WAIT, default 30 min)."""
+    return _env_float("BENCH_TPU_WAIT", 1800.0)
+
+
+def _start_watchdog(result: dict, done: "threading.Event",
+                    budget: Optional[float] = None) -> None:
     """A single wedged device dispatch must not cost the whole capture: a
     tunneled TPU call can block forever (observed mid-run, 2026-07-31 —
     the same failure mode the init-time probe sentinel already guards).
@@ -78,11 +96,14 @@ def _start_watchdog(result: dict, done: "threading.Event") -> None:
     error naming the wedged stage, then hard-exits.  os._exit aborts the
     in-flight XLA call, which can wedge the chip lease — acceptable only
     because a lease stuck under a hung dispatch is already lost to this
-    process, and a partial capture beats none."""
-    try:
-        budget = float(os.environ.get("BENCH_WATCHDOG_S", "2700") or 0)
-    except ValueError:  # malformed override must not cost the JSON contract
-        budget = 2700.0
+    process, and a partial capture beats none.  The measuring-phase
+    instance starts AFTER the device probe (the probe's lease wait has
+    its own budget and must not eat the measuring budget); a separate
+    probe-phase instance with ``budget`` = lease wait + slack covers the
+    probe loop AND the unbounded in-process ``jax.devices()`` init, which
+    can hang exactly like the subprocess probe it follows."""
+    if budget is None:
+        budget = _env_float("BENCH_WATCHDOG_S", 2700.0)
     if budget <= 0:
         return
 
@@ -132,11 +153,16 @@ def _probe_accelerator(timeout: float = 120.0) -> Optional[tuple]:
 
 
 def _devices_with_retry(retries: int = 3, delay: float = 20.0):
-    """Probe the accelerator out-of-process with retries; fall back to CPU
-    so the bench always produces a measured number (round-1 failure mode:
-    one transient axon UNAVAILABLE crashed the whole bench).  A HUNG
-    probe (wedged chip lease — recovers in tens of minutes, not seconds)
-    is not retried: better to spend the budget measuring on CPU."""
+    """Probe the accelerator out-of-process until it answers, then fall
+    back to CPU so the bench always produces a measured number (round-1
+    failure mode: one transient axon UNAVAILABLE crashed the whole
+    bench).  A HUNG probe means a wedged chip lease — observed recoveries
+    (ROUND3.md) land on the tens-of-minutes scale, and the driver-run
+    capture is the only number that counts — so the lease is WAITED OUT:
+    re-probe on a backoff loop up to BENCH_TPU_WAIT seconds (default
+    30 min; 0 disables the wait) before surrendering to CPU.  Quick
+    FAILURES (probe raises rather than hangs) keep the old short-retry
+    behavior: ``retries`` tries ``delay`` apart."""
     import jax
 
     if os.environ.get("HANDYRL_PLATFORM") == "cpu":
@@ -144,29 +170,47 @@ def _devices_with_retry(retries: int = 3, delay: float = 20.0):
         jax.config.update("jax_platforms", "cpu")
         return jax.devices(), None
 
+    wait_budget = _tpu_wait_budget()
+    reprobe_wait = min(150.0, max(wait_budget, 1.0))
+
     err = None
     tried = 0
-    for attempt in range(retries):
-        tried = attempt + 1
+    fail_tries = 0
+    t_wait0 = time.perf_counter()
+    while True:
+        tried += 1
         probe = _probe_accelerator()
         if probe is None:
             try:
                 return jax.devices(), None
             except Exception as exc:  # probe ok but in-process init failed
-                err = str(exc)
-                _note(f"accelerator probe failed ({err}); retrying")
-                if attempt + 1 < retries:
-                    time.sleep(delay)
-                continue
+                probe = ("failed", str(exc))
         kind, err = probe
+        waited = time.perf_counter() - t_wait0
         if kind == "hung":
-            break  # wedged lease clears in tens of minutes; don't burn budget
-        _note(f"accelerator probe failed ({err}); retrying")
-        if attempt + 1 < retries:
-            time.sleep(delay)
+            # each probe itself holds 120 s, so probe+sleep cycles every
+            # ~4.5 min: ~7 chances for the lease to clear inside 30 min
+            if waited + reprobe_wait < wait_budget:
+                _note(
+                    f"accelerator probe hung (wedged lease?); waited "
+                    f"{waited:.0f}s of {wait_budget:.0f}s budget; "
+                    f"re-probing in {reprobe_wait:.0f}s"
+                )
+                time.sleep(reprobe_wait)
+                continue
+        else:
+            fail_tries += 1
+            if fail_tries < retries:
+                _note(f"accelerator probe failed ({err}); retrying")
+                time.sleep(delay)
+                continue
+        break
     try:
         jax.config.update("jax_platforms", "cpu")
-        return jax.devices(), f"accelerator unavailable after {tried} tries ({err}); CPU fallback"
+        return jax.devices(), (
+            f"accelerator unavailable after {tried} tries over "
+            f"{time.perf_counter() - t_wait0:.0f}s ({err}); CPU fallback"
+        )
     except Exception as exc2:
         return None, f"no backend at all: {err} / {exc2}"
 
@@ -834,6 +878,37 @@ def _flash_attention_bench(duration: float = 3.0):
     }
 
 
+def _run_stage(result: dict, name: str, fn, retries: int = 1,
+               retry_delay: float = 20.0):
+    """Run one bench stage with a single retry.  One transient failure
+    (dropped tunnel connection, axon UNAVAILABLE — the r3s3 capture lost
+    the whole flash stage to a single 'remote_compile: Connection
+    refused') must not null a stage's numbers: a failed stage re-runs
+    once after a short wait, and the per-stage error lands in
+    result["error"] only when every attempt fails.  A failed attempt's
+    PARTIAL writes to ``result`` are rolled back (a stage that died after
+    recording throughput must not leave numbers that read as measured),
+    and every attempt's traceback is kept.  Returns the stage's value, or
+    None after final failure."""
+    errs = []
+    for attempt in range(retries + 1):
+        snap = {k: result[k] for k in ("value", "vs_baseline", "error")}
+        snap_extra = dict(result["extra"])
+        try:
+            return fn()
+        except Exception:
+            result.update(snap)
+            result["extra"] = snap_extra
+            errs.append(f"attempt {attempt + 1}: "
+                        + traceback.format_exc(limit=3))
+            if attempt < retries:
+                _note(f"{name}: attempt {attempt + 1} failed; retrying in "
+                      f"{retry_delay:.0f}s")
+                time.sleep(retry_delay)
+    result["error"] = (result["error"] or "") + f" {name}: " + " | ".join(errs)
+    return None
+
+
 def main() -> None:
     result = {
         "metric": "tictactoe_trained_env_steps_per_sec",
@@ -846,20 +921,30 @@ def main() -> None:
     }
 
     done = threading.Event()
-    _start_watchdog(result, done)
 
+    # probe-phase watchdog: bounds the lease-wait loop AND the in-process
+    # jax.devices() init (which can hang just like the subprocess probe)
+    probe_done = threading.Event()
+    _start_watchdog(result, probe_done, budget=_tpu_wait_budget() + 900.0)
     devices, backend_err = _devices_with_retry()
+    probe_done.set()
     if backend_err:
         result["error"] = str(backend_err)
     if devices is None:
-        done.set()
         print(json.dumps(result))
         return
     result["platform"] = f"{devices[0].platform}:{getattr(devices[0], 'device_kind', '?')} x{len(devices)}"
 
+    # the measuring watchdog clock starts AFTER the probe: waiting out a
+    # wedged lease (up to BENCH_TPU_WAIT) must not eat the measuring budget
+    _start_watchdog(result, done)
+
+    peak = _peak_flops(devices[0])
+    n_dev = len(devices)
+
     # 1. headline: TicTacToe train throughput (same metric as round 1)
-    try:
-        ttt = _train_bench("TicTacToe", {}, T_TRAIN, len(devices), fused=True)
+    def stage_tictactoe():
+        ttt = _train_bench("TicTacToe", {}, T_TRAIN, n_dev, fused=True)
         result["value"] = round(ttt["trained_env_steps_per_sec"], 1)
         result["vs_baseline"] = round(
             ttt["trained_env_steps_per_sec"] / REFERENCE_TRAINED_STEPS_PER_SEC, 3
@@ -874,13 +959,22 @@ def main() -> None:
                 * ttt["args"]["batch_size"] * ttt["args"]["forward_steps"],
                 1,
             )
+        # MFU at the fastest update rate this model reaches (fused when
+        # available); tiny net, so the honest number is tiny — reported
+        # anyway (VERDICT r3 item 2: every path states its MFU or why not)
+        if ttt["flops_per_step"] and peak:
+            ups = ttt.get("fused_updates_per_sec") or ttt["updates_per_sec"]
+            result["extra"]["tictactoe_mfu"] = _sig(
+                ttt["flops_per_step"] * ups / (peak * n_dev)
+            )
         if ttt.get("fused_error"):
             result["error"] = (result["error"] or "") + " ttt-fused: " + ttt["fused_error"]
-    except Exception:
-        result["error"] = (result["error"] or "") + " tictactoe: " + traceback.format_exc(limit=3)
+        return ttt
+
+    _run_stage(result, "tictactoe", stage_tictactoe)
 
     # 1b. on-device self-play: the zero-host-round-trip actor plane
-    try:
+    def stage_device_selfplay():
         dsp = _device_selfplay_bench(T_GEN / 2)
         result["extra"]["device_selfplay_env_steps_per_sec"] = round(
             dsp["env_steps_per_sec"], 1
@@ -888,16 +982,14 @@ def main() -> None:
         result["extra"]["device_selfplay_vs_reference_gen"] = round(
             dsp["env_steps_per_sec"] / REFERENCE_GEN_STEPS_PER_SEC, 2
         )
-    except Exception:
-        result["error"] = (result["error"] or "") + " device-selfplay: " + traceback.format_exc(limit=3)
+
+    _run_stage(result, "device-selfplay", stage_device_selfplay)
 
     geese_over = {"turn_based_training": False, "observation": False}
 
     # 1c. north-star actor plane, on-device: streaming HungryGeese self-play
-    try:
-        gd = _streaming_selfplay_bench(
-            "HungryGeese", geese_over, T_GEN / 2
-        )
+    def stage_geese_device_selfplay():
+        gd = _streaming_selfplay_bench("HungryGeese", geese_over, T_GEN / 2)
         result["extra"]["geese_device_selfplay_env_steps_per_sec"] = round(
             gd["env_steps_per_sec"], 1
         )
@@ -912,38 +1004,37 @@ def main() -> None:
         result["extra"]["geese_device_selfplay_vs_reference_gen"] = round(
             gd["env_steps_per_sec"] / REFERENCE_GEN_STEPS_PER_SEC, 2
         )
-    except Exception:
-        result["error"] = (result["error"] or "") + " geese-device-selfplay: " + traceback.format_exc(limit=3)
+
+    _run_stage(result, "geese-device-selfplay", stage_geese_device_selfplay)
 
     # 2. host actor plane: HungryGeese generation through the engine
     # (32 actors x 4 simultaneous players pre-submit -> deep request queue,
     # so each device round-trip serves a full inference batch even when
     # per-call latency is high, e.g. a tunneled chip)
-    try:
+    def stage_geese_gen():
         gen = _generation_bench("HungryGeese", geese_over, T_GEN, num_actors=32)
         result["extra"]["geese_gen_env_steps_per_sec"] = round(gen["env_steps_per_sec"], 1)
         result["extra"]["geese_gen_vs_reference"] = round(
             gen["env_steps_per_sec"] / REFERENCE_GEN_STEPS_PER_SEC, 3
         )
         result["extra"]["geese_gen_mean_infer_batch"] = round(gen["mean_infer_batch"], 1)
-    except Exception:
-        result["error"] = (result["error"] or "") + " geese-gen: " + traceback.format_exc(limit=3)
+
+    _run_stage(result, "geese-gen", stage_geese_gen)
 
     # 3. north-star learner plane: GeeseNet train + starvation + MFU
-    try:
-        gt = _train_bench("HungryGeese", geese_over, T_TRAIN, len(devices))
+    def stage_geese_train():
+        gt = _train_bench("HungryGeese", geese_over, T_TRAIN, n_dev)
         result["extra"]["geese_trained_env_steps_per_sec"] = _sig(
             gt["trained_env_steps_per_sec"], 5
         )
         result["extra"]["geese_updates_per_sec"] = _sig(gt["updates_per_sec"])
         # MFU is ALWAYS reported — as a number, or as null plus the reason
         # (round 2 silently omitted it when the peak-FLOPs lookup missed)
-        peak = _peak_flops(devices[0])
         if gt["flops_per_step"]:
             result["extra"]["geese_flops_per_step"] = gt["flops_per_step"]
             if peak:
                 result["extra"]["geese_mfu"] = round(
-                    gt["flops_per_step"] * gt["updates_per_sec"] / (peak * len(devices)), 4
+                    gt["flops_per_step"] * gt["updates_per_sec"] / (peak * n_dev), 4
                 )
             else:
                 result["extra"]["geese_mfu"] = None
@@ -955,88 +1046,93 @@ def main() -> None:
             result["extra"]["geese_mfu"] = None
             result["extra"]["geese_mfu_note"] = (
                 "XLA cost analysis returned no flops from either the native "
-                "or the CPU-backend lowering"
+                "or the CPU-backend lowering, and the analytic jaxpr counter "
+                "also came up empty"
             )
         pipe = _pipeline_bench(gt, T_TRAIN)
         result["extra"]["geese_pipeline_updates_per_sec"] = _sig(pipe["updates_per_sec"])
         result["extra"]["geese_input_wait_frac"] = round(pipe["input_wait_frac"], 4)
-    except Exception:
-        gt = None
-        result["error"] = (result["error"] or "") + " geese-train: " + traceback.format_exc(limit=3)
+        return gt
+
+    gt = _run_stage(result, "geese-train", stage_geese_train)
 
     # 3c. the north-star loop itself: device self-play feeding training,
     # concurrently, on the same chip (VERDICT r2 item 2)
-    try:
-        if gt is not None:
-            ns = _concurrent_northstar_bench(gt, T_TRAIN)
-            if "skipped" in ns:
-                result["extra"]["northstar_note"] = ns["skipped"]
-            else:
-                result["extra"]["northstar_concurrent_trained_env_steps_per_sec"] = _sig(
-                    ns["trained_env_steps_per_sec"], 5
-                )
-                result["extra"]["northstar_concurrent_selfplay_env_steps_per_sec"] = _sig(
-                    ns["selfplay_env_steps_per_sec"], 5
-                )
-                result["extra"]["northstar_input_wait_frac"] = round(ns["input_wait_frac"], 4)
-                result["extra"]["northstar_per_chip_frac"] = _sig(
-                    ns["per_chip_northstar_frac"]
-                )
-                if ns.get("rollout_error"):
-                    result["error"] = (result["error"] or "") + " northstar-rollout: " + ns["rollout_error"]
-    except Exception:
-        result["error"] = (result["error"] or "") + " northstar: " + traceback.format_exc(limit=3)
+    def stage_northstar():
+        ns = _concurrent_northstar_bench(gt, T_TRAIN)
+        if "skipped" in ns:
+            result["extra"]["northstar_note"] = ns["skipped"]
+            return
+        result["extra"]["northstar_concurrent_trained_env_steps_per_sec"] = _sig(
+            ns["trained_env_steps_per_sec"], 5
+        )
+        result["extra"]["northstar_concurrent_selfplay_env_steps_per_sec"] = _sig(
+            ns["selfplay_env_steps_per_sec"], 5
+        )
+        result["extra"]["northstar_input_wait_frac"] = round(ns["input_wait_frac"], 4)
+        result["extra"]["northstar_per_chip_frac"] = _sig(ns["per_chip_northstar_frac"])
+        if ns.get("rollout_error"):
+            result["error"] = (result["error"] or "") + " northstar-rollout: " + ns["rollout_error"]
+
+    if gt is not None:
+        _run_stage(result, "northstar", stage_northstar)
 
     # 3d. north-star v2: device-resident replay — records ingested into
     # on-device rings, batches sampled + assembled + stepped in ONE
-    # dispatch; the data path never touches the host
-    try:
-        if gt is not None:
-            ns2 = _device_replay_northstar_bench(gt, T_TRAIN)
-            if "skipped" in ns2:
-                result["extra"]["northstar2_note"] = ns2["skipped"]
-            else:
-                result["extra"]["northstar2_trained_env_steps_per_sec"] = _sig(
-                    ns2["trained_env_steps_per_sec"], 5
-                )
-                result["extra"]["northstar2_selfplay_env_steps_per_sec"] = _sig(
-                    ns2["selfplay_env_steps_per_sec"], 5
-                )
-                result["extra"]["northstar2_rollout_time_frac"] = round(
-                    ns2["rollout_time_frac"], 4
-                )
-                result["extra"]["northstar2_per_chip_frac"] = _sig(
-                    ns2["per_chip_northstar_frac"]
-                )
-                if not ns2["loss_finite"]:
-                    result["error"] = (result["error"] or "") + " northstar2: non-finite loss"
-    except Exception:
-        result["error"] = (result["error"] or "") + " northstar2: " + traceback.format_exc(limit=3)
+    # dispatch; the data path never touches the host.  Lane/fuse geometry
+    # from the round-4 duty-cycle sweep (BASELINE.md): more SGD per
+    # rollout call so the chip trains instead of only self-playing.
+    def stage_northstar2():
+        ns2 = _device_replay_northstar_bench(gt, T_TRAIN)
+        if "skipped" in ns2:
+            result["extra"]["northstar2_note"] = ns2["skipped"]
+            return
+        result["extra"]["northstar2_trained_env_steps_per_sec"] = _sig(
+            ns2["trained_env_steps_per_sec"], 5
+        )
+        result["extra"]["northstar2_selfplay_env_steps_per_sec"] = _sig(
+            ns2["selfplay_env_steps_per_sec"], 5
+        )
+        result["extra"]["northstar2_rollout_time_frac"] = round(
+            ns2["rollout_time_frac"], 4
+        )
+        result["extra"]["northstar2_per_chip_frac"] = _sig(
+            ns2["per_chip_northstar_frac"]
+        )
+        # train-plane MFU of the all-on-device loop: same jitted step as
+        # stage 3 (same batch geometry), so gt's flops/step applies
+        if gt["flops_per_step"] and peak:
+            result["extra"]["northstar2_train_mfu"] = _sig(
+                gt["flops_per_step"] * ns2["updates_per_sec"] / (peak * n_dev)
+            )
+        if not ns2["loss_finite"]:
+            result["error"] = (result["error"] or "") + " northstar2: non-finite loss"
+
+    if gt is not None:
+        _run_stage(result, "northstar2", stage_northstar2)
 
     # 3b. bf16 mixed precision (MXU-rate forward/backward, fp32 master
     # weights) on the same store — the compute_dtype knob's headroom
-    try:
-        if gt is not None:
-            gt16 = _train_bench(
-                "HungryGeese", {**geese_over, "compute_dtype": "bfloat16"},
-                T_TRAIN, len(devices), reuse=gt,
-            )
-            result["extra"]["geese_bf16_updates_per_sec"] = _sig(
-                gt16["updates_per_sec"]
-            )
-    except Exception:
-        result["error"] = (result["error"] or "") + " geese-bf16: " + traceback.format_exc(limit=3)
+    def stage_geese_bf16():
+        gt16 = _train_bench(
+            "HungryGeese", {**geese_over, "compute_dtype": "bfloat16"},
+            T_TRAIN, n_dev, reuse=gt,
+        )
+        result["extra"]["geese_bf16_updates_per_sec"] = _sig(gt16["updates_per_sec"])
+
+    if gt is not None:
+        _run_stage(result, "geese-bf16", stage_geese_bf16)
 
     # 4. recurrent path: Geister DRC ConvLSTM with burn-in + UPGO — the
     # long-horizon imperfect-info config (BASELINE.json configs[3]); the
     # train step here is a T-step lax.scan with masked hidden carry
-    try:
+    def stage_geister():
         geister = _train_bench(
             "Geister",
             {"burn_in_steps": 8, "forward_steps": 16, "observation": True,
              "policy_target": "UPGO", "value_target": "UPGO"},
             T_TRAIN,
-            len(devices),
+            n_dev,
             fill_episodes=12,  # 200-turn episodes; filling dominates otherwise
         )
         result["extra"]["geister_rnn_updates_per_sec"] = _sig(
@@ -1045,12 +1141,12 @@ def main() -> None:
         result["extra"]["geister_rnn_trained_env_steps_per_sec"] = _sig(
             geister["trained_env_steps_per_sec"], 5
         )
-    except Exception:
-        result["error"] = (result["error"] or "") + " geister: " + traceback.format_exc(limit=3)
+
+    _run_stage(result, "geister", stage_geister)
 
     # 4b. recurrent on-device self-play: Geister with the DRC ConvLSTM —
     # turn-based streaming lanes carrying per-player hidden state
-    try:
+    def stage_geister_device_selfplay():
         gsd = _streaming_selfplay_bench(
             "Geister", {"observation": True}, T_GEN / 2,
             n_lanes=128, k_steps=32,
@@ -1063,43 +1159,39 @@ def main() -> None:
         )
         if gsd["episodes_note"]:
             result["extra"]["geister_device_selfplay_episodes_note"] = gsd["episodes_note"]
-    except Exception:
-        result["error"] = (result["error"] or "") + " geister-device-selfplay: " + traceback.format_exc(limit=3)
+
+    _run_stage(result, "geister-device-selfplay", stage_geister_device_selfplay)
 
     # 4c. turn-mode device-resident replay: Geister DRC trained straight
     # from device rings (all-player burn-in windows, runtime/device_replay
     # turn mode) concurrent with streaming self-play — TPU-gated: on CPU
     # the DRC window compile dominates any timed window
-    try:
-        import jax
-
-        if jax.default_backend() == "tpu":
-            gdr = _geister_device_replay_bench(T_TRAIN)
-            if "skipped" in gdr:  # benign prefill timeout, like stage 3d
-                result["extra"]["geister_devreplay_note"] = gdr["skipped"]
-            else:
-                result["extra"]["geister_devreplay_updates_per_sec"] = _sig(
-                    gdr["updates_per_sec"]
-                )
-                result["extra"]["geister_devreplay_trained_env_steps_per_sec"] = _sig(
-                    gdr["trained_env_steps_per_sec"], 5
-                )
-                result["extra"]["geister_devreplay_selfplay_env_steps_per_sec"] = _sig(
-                    gdr["selfplay_env_steps_per_sec"]
-                )
-                if not gdr["loss_finite"]:
-                    result["error"] = (result["error"] or "") + " geister-devreplay: non-finite loss"
-    except Exception:
-        result["error"] = (result["error"] or "") + " geister-devreplay: " + traceback.format_exc(limit=3)
+    def stage_geister_devreplay():
+        gdr = _geister_device_replay_bench(T_TRAIN)
+        if "skipped" in gdr:  # benign prefill timeout, like stage 3d
+            result["extra"]["geister_devreplay_note"] = gdr["skipped"]
+            return
+        result["extra"]["geister_devreplay_updates_per_sec"] = _sig(
+            gdr["updates_per_sec"]
+        )
+        result["extra"]["geister_devreplay_trained_env_steps_per_sec"] = _sig(
+            gdr["trained_env_steps_per_sec"], 5
+        )
+        result["extra"]["geister_devreplay_selfplay_env_steps_per_sec"] = _sig(
+            gdr["selfplay_env_steps_per_sec"]
+        )
+        if not gdr["loss_finite"]:
+            result["error"] = (result["error"] or "") + " geister-devreplay: non-finite loss"
 
     # 5. seq-attention kernel crossover (einsum vs Pallas flash, fwd+bwd)
-    try:
-        import jax
+    def stage_flash():
+        result["extra"]["flash_attention"] = _flash_attention_bench()
 
-        if jax.default_backend() == "tpu":  # kernel path only exists on TPU
-            result["extra"]["flash_attention"] = _flash_attention_bench()
-    except Exception:
-        result["error"] = (result["error"] or "") + " flash: " + traceback.format_exc(limit=3)
+    import jax
+
+    if jax.default_backend() == "tpu":
+        _run_stage(result, "geister-devreplay", stage_geister_devreplay)
+        _run_stage(result, "flash", stage_flash)  # kernel path is TPU-only
 
     done.set()
     print(json.dumps(result))
