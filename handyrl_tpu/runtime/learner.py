@@ -187,6 +187,12 @@ class Learner:
             if opp not in ("random", "rulebase") or (
                 opp == "rulebase" and not hasattr(venv, "rule_based_action_all")
             ):
+                # downgrading must be loud: a config asking for rulebase
+                # curves would otherwise quietly chart a different opponent
+                print(
+                    f"[handyrl_tpu] device eval: opponent '{opp}' unavailable "
+                    f"for this vector env; evaluating vs 'random' instead"
+                )
                 opp = "random"
             from .device_eval import DeviceEvaluator
 

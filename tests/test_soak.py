@@ -205,5 +205,9 @@ def test_geister_drc_beats_random(tmp_path, monkeypatch):
     assert len(win) >= 40, f"only {len(win)} eval epochs recorded"
     early = float(np.mean(win[:20]))
     late = float(np.mean(win[-20:]))
-    assert late > early, f"no climb vs random: {early:.3f} -> {late:.3f}"
-    assert late >= 0.55, f"final win rate vs random {late:.3f} (early {early:.3f})"
+    # margins sized from the recorded passes (round 3: 0.569 -> 0.649,
+    # peak 0.902; on-chip run: +0.35): a floor of 0.55 with any positive
+    # climb let a substantially regressed DRC path still pass, so the bar
+    # asks for a clear climb AND a 0.60 late-window mean
+    assert late > early + 0.05, f"no clear climb vs random: {early:.3f} -> {late:.3f}"
+    assert late >= 0.60, f"final win rate vs random {late:.3f} (early {early:.3f})"
