@@ -85,8 +85,18 @@ def main():
         "ParallelTicTacToe (dynamics)", ref_pttt, our_pttt, num_games,
         turn_based=False, compare_obs=False,
     )
-    # HungryGeese's reference needs kaggle_environments (not installable
-    # here) — rule-by-rule diff lives in docs/hungry_geese_parity.md.
+    # HungryGeese's ground truth is kaggle_environments (not installable
+    # here): tools/crosscheck_kaggle.py machine-checks it where the dep
+    # exists (CI extras job); rule-by-rule diff: docs/hungry_geese_parity.md.
+    import importlib.util
+
+    if importlib.util.find_spec("kaggle_environments"):
+        from crosscheck_kaggle import crosscheck_hungry_geese
+
+        crosscheck_hungry_geese(num_games, verbose=False)
+        print(f"HungryGeese: {num_games} games identical vs kaggle engine")
+    else:
+        print("HungryGeese: SKIPPED (kaggle_environments not installed)")
 
 
 if __name__ == "__main__":
