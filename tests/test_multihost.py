@@ -85,6 +85,109 @@ else:
 """
 
 
+# The gradient plane's core claim (SURVEY §2.5): TrainContext.train_step
+# — value_and_grad + the GSPMD gradient all-reduce — executed ACROSS
+# processes on per-process local batch shards must produce the same
+# params on every process, and the same update a single process computes
+# from the full batch.  Both processes seed identically, generate the
+# SAME episodes/windows via the real generator, then feed only their own
+# rows through put_batch's make_array_from_process_local_data branch.
+_TRAIN_CHILD = r"""
+import json, os, sys
+
+port, pid, nproc, outdir = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from handyrl_tpu.parallel import init_distributed, is_coordinator, make_mesh
+
+init_distributed(
+    {"coordinator_address": f"127.0.0.1:{port}", "num_processes": nproc, "process_id": pid}
+)
+
+sys.path.insert(0, os.getcwd())  # parent sets cwd to the tests dir
+from test_multihost import build_ttt_batch, run_one_train_step
+
+batch, module, params, args = build_ttt_batch()
+mesh = make_mesh({"dp": -1})
+B_local = batch["action"].shape[0] // nproc
+local = jax.tree.map(lambda x: x[pid * B_local:(pid + 1) * B_local], batch)
+new_params, loss = run_one_train_step(module, args, mesh, params, local)
+
+leaves = [np.asarray(x) for x in jax.tree.leaves(new_params)]
+np.savez(os.path.join(outdir, f"params_{pid}.npz"), loss=loss, *leaves)
+"""
+
+
+def build_ttt_batch():
+    """Deterministic TicTacToe batch + module + init params (seeded global
+    RNGs: every caller that seeds the same way gets byte-identical data)."""
+    import random as pyrandom
+
+    import numpy as np
+
+    pyrandom.seed(1234)
+    np.random.seed(1234)
+
+    from handyrl_tpu.config import normalize_args
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.models import InferenceModel, RandomModel, init_variables
+    from handyrl_tpu.runtime import EpisodeStore, Generator, make_batch
+
+    cfg = normalize_args(
+        # compaction off: the multi-process path skips it by design (all
+        # processes must agree on global shapes), so the single-process
+        # reference run must train the same uncompacted program
+        {"env_args": {"env": "TicTacToe"},
+         "train_args": {"batch_size": 4, "compact_padding": False}}
+    )
+    args = dict(cfg["train_args"])
+    args["env"] = cfg["env_args"]
+
+    env = make_env(args["env"])
+    module = env.net()
+    variables = init_variables(module, env)
+    model = InferenceModel(module, variables)
+    env.reset()
+    random_model = RandomModel.from_model(model, env.observation(env.players()[0]))
+
+    store = EpisodeStore(64)
+    gen = Generator(env, args)
+    gen_args = {"player": env.players(), "model_id": {p: 0 for p in env.players()}}
+    while len(store) < 8:
+        ep = gen.generate({p: random_model for p in env.players()}, gen_args)
+        if ep is not None:
+            store.extend([ep])
+    windows = []
+    while len(windows) < args["batch_size"]:
+        w = store.sample_window(
+            args["forward_steps"], args["burn_in_steps"], args["compress_steps"]
+        )
+        if w is not None:
+            windows.append(w)
+    return make_batch(windows, args), module, variables["params"], args
+
+
+def run_one_train_step(module, args, mesh, params, local_batch):
+    """One real TrainContext.train_step; returns (host params, loss)."""
+    import jax
+    import numpy as np
+
+    from handyrl_tpu.parallel import TrainContext
+
+    ctx = TrainContext(module, args, mesh)
+    state = ctx.init_state(params)
+    device_batch = ctx.put_batch(local_batch)
+    state, metrics = ctx.train_step(state, device_batch, 1e-3)
+    host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), state["params"])
+    return host, float(jax.device_get(metrics["total"]))
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -120,3 +223,71 @@ def test_two_process_cpu_distributed(tmp_path):
     assert abs(result["total"] - 18.0) < 1e-6
     assert (tmp_path / "noncoord_1.txt").exists()
     assert not (tmp_path / "noncoord_0.txt").exists()
+
+
+@pytest.mark.slow
+def test_two_process_train_step(tmp_path):
+    """TrainContext.train_step under jax.distributed: 2 processes x 2
+    virtual devices each run the REAL jitted sharded update on their local
+    batch shard.  Both processes must end with identical params, and those
+    params must match a single-process update on the full batch (the GSPMD
+    gradient all-reduce across processes computes the same mean gradient a
+    single process computes locally, up to float reassociation)."""
+    import numpy as np
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _TRAIN_CHILD, str(port), str(pid), "2", str(tmp_path)],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out.decode(errors="replace"))
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"child failed:\n{out}"
+
+    dumps = [np.load(tmp_path / f"params_{pid}.npz") for pid in range(2)]
+    keys = sorted(
+        (k for k in dumps[0].files if k != "loss"),
+        key=lambda s: int(s.split("_")[1]),  # arr_0..arr_N in leaf order
+    )
+    assert keys, "child dumped no param leaves"
+    # identical across processes (same global program, replicated params)
+    for k in keys:
+        np.testing.assert_array_equal(dumps[0][k], dumps[1][k], err_msg=k)
+    assert float(dumps[0]["loss"]) == float(dumps[1]["loss"])
+
+    # and equal to the single-process update on the full batch — pinned to
+    # the children's CPU backend (a TPU-backend parent would compare
+    # bf16-matmul params against f32 XLA:CPU params and fail spuriously)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from handyrl_tpu.parallel import make_mesh
+
+    batch, module, params, args = build_ttt_batch()
+    ref_params, ref_loss = run_one_train_step(
+        module, args, make_mesh({"dp": 1}), params, batch
+    )
+    ref_leaves = [np.asarray(x) for x in __import__("jax").tree.leaves(ref_params)]
+    assert len(ref_leaves) == len(keys)
+    changed = False
+    init_leaves = [np.asarray(x) for x in __import__("jax").tree.leaves(params)]
+    for k, ref, init in zip(keys, ref_leaves, init_leaves):
+        np.testing.assert_allclose(
+            dumps[0][k], ref, rtol=2e-4, atol=2e-6, err_msg=k
+        )
+        changed = changed or not np.array_equal(ref, init)
+    assert changed, "update was a no-op: params identical to init"
+    assert abs(float(dumps[0]["loss"]) - ref_loss) < 1e-4 * max(1.0, abs(ref_loss))
