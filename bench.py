@@ -41,7 +41,12 @@ from typing import Optional
 
 
 REFERENCE_TRAINED_STEPS_PER_SEC = 39707.0  # measured, BASELINE.md (torch CPU)
-REFERENCE_GEN_STEPS_PER_SEC = 1557.0       # measured, BASELINE.md (torch CPU)
+REFERENCE_GEN_STEPS_PER_SEC = 1557.0       # measured, BASELINE.md (torch CPU, TicTacToe)
+# HungryGeese like-for-like: the reference's own loop shape (batch-1 torch
+# inference per active player, single process) with the reference's own
+# GeeseNet on this host — tools/reference_geese_gen.py.  Rounds 1-3 divided
+# the geese stages by the TICTACTOE row above, understating them 17x.
+REFERENCE_GEESE_GEN_STEPS_PER_SEC = 89.0   # measured 2026-08-01, BASELINE.md
 
 # peak dense bf16 FLOP/s per chip, for MFU accounting (public figures)
 PEAK_FLOPS_BY_KIND = [
@@ -703,7 +708,8 @@ def _concurrent_northstar_bench(train_res, duration: float,
 
 def _device_replay_northstar_bench(train_res, duration: float,
                                    n_lanes: int = 256, k_steps: int = 32,
-                                   fused_steps: int = 8):
+                                   fused_steps: int = 8,
+                                   trains_per_rollout: int = 2):
     """The north-star loop with the DEVICE-RESIDENT replay
     (runtime/device_replay.py): streaming self-play records are ingested
     into on-device ring buffers and training batches are sampled,
@@ -711,8 +717,11 @@ def _device_replay_northstar_bench(train_res, duration: float,
     the host (VERDICT r2 item 2 follow-up: the v1 loop was bounded by a
     ~43 MB obs upload per update plus every episode round-tripping
     device->host->device).  One iteration = 1 rollout call (k_steps x
-    n_lanes game steps) + 2 fused train calls (2 x fused_steps updates),
-    self-play always running under the LATEST params."""
+    n_lanes game steps) + ``trains_per_rollout`` fused train calls
+    (each fused_steps updates), self-play always running under the
+    LATEST params.  The train:rollout call ratio sets the chip's duty
+    split — r3 ran 2 and measured rollout_time_frac 0.957 (the chip
+    mostly self-played); tools/tune_northstar.py sweeps the geometry."""
     import jax
 
     from handyrl_tpu.envs import make_env
@@ -777,7 +786,7 @@ def _device_replay_northstar_bench(train_res, duration: float,
         stats.append(rollout())
         jax.block_until_ready(stats[-1]["episodes"])
         rollout_s += time.perf_counter() - tr
-        for _ in range(2):
+        for _ in range(trains_per_rollout):
             key, sub = jax.random.split(key)
             state, m = train(state, sub, 1e-5)
             updates += fused_steps
@@ -791,12 +800,24 @@ def _device_replay_northstar_bench(train_res, duration: float,
     episodes = sum(int(s["episodes"]) for s in fetched)
     selfplay_rate = game_steps / dt
     n_chips = mesh.size
+    consumed = updates * args["batch_size"] * args["forward_steps"] / dt
     return {
-        "trained_env_steps_per_sec": updates * args["batch_size"] * args["forward_steps"] / dt,
+        # EFFECTIVE geometry (post the non-TPU clamps above) — sweep rows
+        # must echo what actually ran, not what was requested
+        "lanes": n_lanes,
+        "k_steps": k_steps,
+        "fused_steps": fused_steps,
+        "trains_per_rollout": trains_per_rollout,
+        "trained_env_steps_per_sec": consumed,
         "updates_per_sec": updates / dt,
         "selfplay_env_steps_per_sec": selfplay_rate,
         "rollout_time_frac": rollout_s / dt,
         "episodes": episodes,
+        # >1: self-play produces faster than training consumes (fresh
+        # data regime); <1: windows are re-sampled (replay-ratio regime).
+        # The tuning target is rollout_time_frac <= 0.5 while this stays
+        # near or above ~0.5 (each sample reused at most ~2x).
+        "produce_consume_ratio": selfplay_rate / consumed if consumed else None,
         "per_chip_northstar_frac": selfplay_rate / (3125.0 * n_chips),
         "loss_finite": bool(jax.numpy.isfinite(jax.device_get(m["total"]))),
     }
@@ -1002,7 +1023,7 @@ def main() -> None:
         if gd["episodes_note"]:
             result["extra"]["geese_device_selfplay_episodes_note"] = gd["episodes_note"]
         result["extra"]["geese_device_selfplay_vs_reference_gen"] = round(
-            gd["env_steps_per_sec"] / REFERENCE_GEN_STEPS_PER_SEC, 2
+            gd["env_steps_per_sec"] / REFERENCE_GEESE_GEN_STEPS_PER_SEC, 2
         )
 
     _run_stage(result, "geese-device-selfplay", stage_geese_device_selfplay)
@@ -1015,7 +1036,7 @@ def main() -> None:
         gen = _generation_bench("HungryGeese", geese_over, T_GEN, num_actors=32)
         result["extra"]["geese_gen_env_steps_per_sec"] = round(gen["env_steps_per_sec"], 1)
         result["extra"]["geese_gen_vs_reference"] = round(
-            gen["env_steps_per_sec"] / REFERENCE_GEN_STEPS_PER_SEC, 3
+            gen["env_steps_per_sec"] / REFERENCE_GEESE_GEN_STEPS_PER_SEC, 3
         )
         result["extra"]["geese_gen_mean_infer_batch"] = round(gen["mean_infer_batch"], 1)
 
@@ -1095,6 +1116,9 @@ def main() -> None:
         )
         result["extra"]["northstar2_rollout_time_frac"] = round(
             ns2["rollout_time_frac"], 4
+        )
+        result["extra"]["northstar2_produce_consume_ratio"] = _sig(
+            ns2["produce_consume_ratio"]
         )
         result["extra"]["northstar2_per_chip_frac"] = _sig(
             ns2["per_chip_northstar_frac"]
