@@ -1,0 +1,103 @@
+"""Duty-cycle sweep for the north-star v2 loop (VERDICT r3 item 3).
+
+Round 3 measured `northstar2_rollout_time_frac` 0.957: the chip spent 25x
+more time on self-play rollouts than on SGD, so the "107k trained
+steps/s" headline was mostly a rollout benchmark.  This sweeps the loop
+geometry — lanes x k_steps (rollout work per call), fused_steps x
+trains_per_rollout (SGD work per iteration) — through the REAL bench
+stage (`bench._device_replay_northstar_bench`) and prints one row per
+combo, so the knee (rollout_time_frac <= 0.5 with self-play still
+outpacing or matching consumption, produce_consume_ratio >= ~0.5) can be
+read off and pinned as the bench default + a BASELINE.md row.
+
+Run ON THE CHIP (falls back to CPU with a warning — CPU ratios are not
+representative, but the harness logic can be smoke-tested with
+TUNE_QUICK=1).
+
+Usage: python tools/tune_northstar.py [duration_per_combo_s]
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import bench  # noqa: E402  (repo-root import)
+
+
+def main() -> None:
+    import jax
+
+    duration = float(sys.argv[1]) if len(sys.argv) > 1 else 8.0
+    quick = bool(os.environ.get("TUNE_QUICK"))
+    backend = jax.default_backend()
+    if backend != "tpu":
+        print(f"WARNING: backend is {backend}; ratios are not TPU-representative",
+              file=sys.stderr)
+
+    # geese train context once; reused across combos (same jitted step)
+    bench._note("tune: building geese train context + store")
+    gt = bench._train_bench(
+        "HungryGeese", {"turn_based_training": False, "observation": False},
+        2.0, len(jax.devices()),
+        fill_episodes=12 if quick else 48,
+    )
+
+    if quick:
+        combos = [(32, 16, 2, t) for t in (1, 4)]
+    else:
+        combos = list(itertools.product(
+            (128, 256),       # n_lanes
+            (16, 32),         # k_steps
+            (8,),             # fused_steps
+            (2, 4, 8, 16),    # trains_per_rollout
+        ))
+    rows = []
+    for lanes, k, fused, trains in combos:
+        t0 = time.perf_counter()
+        try:
+            r = bench._device_replay_northstar_bench(
+                gt, duration, n_lanes=lanes, k_steps=k,
+                fused_steps=fused, trains_per_rollout=trains,
+            )
+        except Exception as exc:  # keep sweeping; record the failure
+            r = {"skipped": f"{type(exc).__name__}: {exc}"}
+        # echo the EFFECTIVE geometry from the bench result (off-TPU the
+        # stage clamps lanes/fused_steps; a knee read off requested values
+        # would pin a geometry that was never measured)
+        row = {"lanes": r.get("lanes", lanes), "k_steps": r.get("k_steps", k),
+               "fused": r.get("fused_steps", fused),
+               "trains_per_rollout": r.get("trains_per_rollout", trains),
+               "wall_s": round(time.perf_counter() - t0, 1)}
+        if "skipped" in r:
+            row["skipped"] = r["skipped"]
+        else:
+            row.update(
+                updates_per_sec=round(r["updates_per_sec"], 1),
+                trained_steps_per_sec=round(r["trained_env_steps_per_sec"], 0),
+                selfplay_steps_per_sec=round(r["selfplay_env_steps_per_sec"], 0),
+                rollout_time_frac=round(r["rollout_time_frac"], 3),
+                produce_consume=round(r["produce_consume_ratio"], 3)
+                if r["produce_consume_ratio"] else None,
+            )
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    ok = [r for r in rows if "skipped" not in r]
+    # knee: most trained steps/s among combos that keep the loop fed
+    fed = [r for r in ok if r["produce_consume"] and r["produce_consume"] >= 0.5]
+    if fed:
+        best = max(fed, key=lambda r: r["trained_steps_per_sec"])
+        print("KNEE:", json.dumps(best))
+    elif ok:
+        print("KNEE: none kept produce_consume >= 0.5; fastest overall:",
+              json.dumps(max(ok, key=lambda r: r["trained_steps_per_sec"])))
+
+
+if __name__ == "__main__":
+    main()
