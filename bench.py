@@ -77,10 +77,15 @@ _LAST_NOTE = "startup"
 
 
 def _env_float(name: str, default: float) -> float:
-    """Env override parsed as float; a malformed value falls back to the
-    default rather than costing the capture/JSON contract."""
+    """Env override parsed as float; malformed or SET-BUT-EMPTY values
+    fall back to the default rather than costing the capture (an empty
+    string from CI interpolation must not read as 0 and silently disable
+    the lease wait / watchdog — explicit \"0\" is the disable switch)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
     try:
-        return float(os.environ.get(name, str(default)) or 0)
+        return float(raw)
     except ValueError:
         return default
 

@@ -1,0 +1,140 @@
+"""Unit coverage for bench.py's capture-reliability layer (round 4).
+
+Three rounds of driver captures were lost to exactly these paths — a
+wedged chip lease surrendered after one probe (BENCH_r02/r03 "CPU
+fallback"), and a transient tunnel error nulling a whole stage (r3s3
+flash stage) — so the wait-out loop, the stage retry, and the
+partial-result rollback get direct tests.  The probe subprocess is
+monkeypatched; no accelerator is touched.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import bench
+
+
+@pytest.fixture(autouse=True)
+def _fast_sleep(monkeypatch):
+    """The wait loop sleeps minutes between re-probes; record instead."""
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    yield sleeps
+
+
+def test_env_float_parses_and_falls_back(monkeypatch):
+    monkeypatch.delenv("X_BENCH_T", raising=False)
+    assert bench._env_float("X_BENCH_T", 7.5) == 7.5
+    monkeypatch.setenv("X_BENCH_T", "3")
+    assert bench._env_float("X_BENCH_T", 7.5) == 3.0
+    monkeypatch.setenv("X_BENCH_T", "junk")
+    assert bench._env_float("X_BENCH_T", 7.5) == 7.5
+    # set-but-empty (CI interpolation of an unset variable) means default,
+    # NOT 0 — 0 would silently disable the lease wait / watchdog
+    monkeypatch.setenv("X_BENCH_T", "")
+    assert bench._env_float("X_BENCH_T", 7.5) == 7.5
+    monkeypatch.setenv("X_BENCH_T", "0")
+    assert bench._env_float("X_BENCH_T", 7.5) == 0.0
+
+
+def test_hung_probe_is_reprobed_until_budget(monkeypatch, _fast_sleep):
+    """A hung probe (wedged lease) must be re-probed on a backoff loop —
+    not surrendered after one try (the r02/r03 failure) — and fall back
+    to CPU only once the BENCH_TPU_WAIT budget is spent."""
+    monkeypatch.delenv("HANDYRL_PLATFORM", raising=False)
+    monkeypatch.setenv("BENCH_TPU_WAIT", "1800")
+    probes = []
+
+    def fake_probe(timeout=120.0):
+        probes.append(timeout)
+        return ("hung", "accelerator backend init hung >120s")
+
+    monkeypatch.setattr(bench, "_probe_accelerator", fake_probe)
+    # wall clock advances only with sleep(); probe itself is instant here,
+    # so the loop runs until the sleeps alone exhaust the budget
+    t = [0.0]
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: t[0])
+    monkeypatch.setattr(
+        bench.time, "sleep", lambda s: t.__setitem__(0, t[0] + s)
+    )
+
+    devices, err = bench._devices_with_retry()
+    assert len(probes) > 3, "hung probe was not persistently re-probed"
+    assert err and "CPU fallback" in err and "hung" in err
+    assert devices is not None and devices[0].platform == "cpu"
+
+
+def test_hung_probe_wait_disabled(monkeypatch, _fast_sleep):
+    """BENCH_TPU_WAIT=0 keeps the old immediate-fallback behavior."""
+    monkeypatch.delenv("HANDYRL_PLATFORM", raising=False)
+    monkeypatch.setenv("BENCH_TPU_WAIT", "0")
+    probes = []
+    monkeypatch.setattr(
+        bench, "_probe_accelerator",
+        lambda timeout=120.0: probes.append(1) or ("hung", "hung >120s"),
+    )
+    devices, err = bench._devices_with_retry()
+    assert len(probes) == 1
+    assert err and "CPU fallback" in err
+
+
+def test_failed_probe_keeps_short_retries(monkeypatch, _fast_sleep):
+    """A quick FAILURE (probe raises, not hangs) retries a bounded number
+    of times on the short delay, not the 30-min lease budget."""
+    monkeypatch.delenv("HANDYRL_PLATFORM", raising=False)
+    monkeypatch.setenv("BENCH_TPU_WAIT", "1800")
+    probes = []
+    monkeypatch.setattr(
+        bench, "_probe_accelerator",
+        lambda timeout=120.0: probes.append(1) or ("failed", "UNAVAILABLE"),
+    )
+    devices, err = bench._devices_with_retry(retries=3, delay=1.0)
+    assert len(probes) == 3
+    assert err and "UNAVAILABLE" in err and "CPU fallback" in err
+
+
+def test_run_stage_rolls_back_partial_writes(_fast_sleep):
+    """A stage that dies after recording throughput must not leave numbers
+    that read as measured; every attempt's traceback is kept."""
+    result = {"value": None, "vs_baseline": None, "error": None, "extra": {}}
+    calls = []
+
+    def stage():
+        calls.append(1)
+        result["extra"]["partial"] = 123
+        result["value"] = 999.0
+        raise RuntimeError(f"boom{len(calls)}")
+
+    out = bench._run_stage(result, "s", stage, retry_delay=0.0)
+    assert out is None and len(calls) == 2
+    assert "partial" not in result["extra"] and result["value"] is None
+    assert "attempt 1" in result["error"] and "attempt 2" in result["error"]
+    assert "boom1" in result["error"] and "boom2" in result["error"]
+
+
+def test_run_stage_retry_succeeds_and_keeps_writes(_fast_sleep):
+    result = {"value": None, "vs_baseline": None, "error": None, "extra": {}}
+    calls = []
+
+    def stage():
+        calls.append(1)
+        if len(calls) == 1:
+            result["extra"]["junk"] = 1  # partial write from the failure
+            raise ConnectionRefusedError("remote_compile: Connection refused")
+        result["extra"]["rate"] = 42.0
+        return "ok"
+
+    assert bench._run_stage(result, "s", stage, retry_delay=0.0) == "ok"
+    assert result["error"] is None
+    assert result["extra"] == {"rate": 42.0}
+
+
+def test_sig_preserves_small_rates():
+    assert bench._sig(0.0021234) == 0.00212
+    assert bench._sig(None) is None
+    assert bench._sig(0) == 0
+    assert bench._sig(123456.0) == 123456.0  # never truncates above the decimal
