@@ -48,16 +48,6 @@ REFERENCE_GEN_STEPS_PER_SEC = 1557.0       # measured, BASELINE.md (torch CPU, T
 # the geese stages by the TICTACTOE row above, understating them 17x.
 REFERENCE_GEESE_GEN_STEPS_PER_SEC = 89.0   # measured 2026-08-01, BASELINE.md
 
-# peak dense bf16 FLOP/s per chip, for MFU accounting (public figures)
-PEAK_FLOPS_BY_KIND = [
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5", 197e12),   # v5e / v5 litepod
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
-
 QUICK = bool(os.environ.get("BENCH_QUICK"))
 T_TRAIN = 4.0 if QUICK else 12.0
 T_GEN = 4.0 if QUICK else 10.0
@@ -226,11 +216,11 @@ def _devices_with_retry(retries: int = 3, delay: float = 20.0):
 
 
 def _peak_flops(device) -> float | None:
-    kind = getattr(device, "device_kind", "").lower()
-    for tag, peak in PEAK_FLOPS_BY_KIND:
-        if tag in kind:
-            return peak
-    return None
+    # lazy: bench.py must not import jax (via handyrl_tpu) before the
+    # out-of-process accelerator probe has run
+    from handyrl_tpu.parallel.train_step import peak_flops_per_chip
+
+    return peak_flops_per_chip(device)
 
 
 def _make_args(env_name: str, overrides=None):

@@ -537,6 +537,29 @@ class TrainContext:
             return None
 
 
+# peak dense bf16 FLOP/s per chip (public figures) — the denominator for
+# MFU accounting everywhere (bench.py headline stages, Trainer per-epoch
+# stats -> metrics.jsonl)
+PEAK_FLOPS_BY_KIND = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5", 197e12),   # v5e / v5 litepod
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def peak_flops_per_chip(device) -> Optional[float]:
+    """Peak dense FLOP/s for ``device`` (None when the kind is unknown —
+    callers report MFU as null-with-reason rather than guessing)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for tag, peak in PEAK_FLOPS_BY_KIND:
+        if tag in kind:
+            return peak
+    return None
+
+
 def jaxpr_flops(jaxpr) -> float:
     """Backend-free analytic flop count of a jaxpr: 2*MACs for every
     ``dot_general`` and ``conv_general_dilated``, recursing through

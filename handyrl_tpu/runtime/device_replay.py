@@ -403,6 +403,19 @@ class DeviceReplay:
                 lambda: holder["fn"](state, self.rings, key, jnp.float32(lr))
             )
 
+        def flops_per_update(state) -> float:
+            """Analytic FLOPs of ONE SGD update of this program (trace-only,
+            nothing executes): jaxpr_flops over the fused body / fused_steps.
+            Sampling/assembly are gathers, not FLOPs, so this equals the
+            plain train step's count — used for MFU in Trainer.stats."""
+            from ..parallel.train_step import jaxpr_flops
+
+            jaxpr = jax.make_jaxpr(fn)(
+                state, self.rings, jax.random.PRNGKey(0), jnp.float32(1e-5)
+            )
+            return jaxpr_flops(jaxpr.jaxpr) / fused_steps
+
+        bound.flops_per_update = flops_per_update
         self._train_fns[fused_steps] = bound
         return bound
 

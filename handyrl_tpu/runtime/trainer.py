@@ -173,6 +173,10 @@ class Trainer:
 
         self.default_lr = 3e-8 * args["lr_scale"]
         self.data_cnt_ema = args["batch_size"] * args["forward_steps"]
+        # FLOPs of one SGD update, resolved once at the end of the first
+        # trained epoch (0.0 = tried, unavailable) — feeds the per-epoch
+        # "mfu" stat in metrics.jsonl when the chip's peak rate is known
+        self._flops_per_update: Optional[float] = None
         self.steps = 0
         self.last_loss: Dict[str, float] = {}
         self.stats: Dict[str, float] = {}  # step timing / input-starvation
@@ -281,12 +285,14 @@ class Trainer:
                     # on TPU dispatch is async and the gap never forms.
                     time.sleep(0.02)
         else:
+            last_batch = None
             while data_cnt == 0 or not self.update_flag:
                 t0 = time.perf_counter()
                 batch = self.batcher.batch()
                 wait_s += time.perf_counter() - t0  # input starvation (north-star)
                 if batch is None:  # shutting down
                     break
+                last_batch = batch  # batches aren't donated; safe to re-lower
                 if fused > 1:  # k updates per device call, metrics pre-summed
                     self.state, metrics = self.ctx.train_steps(self.state, batch, lr)
                 else:
@@ -312,9 +318,54 @@ class Trainer:
             "train_steps_per_sec": batch_cnt / elapsed,
             "input_wait_frac": wait_s / elapsed,
         }
+        from ..parallel.train_step import peak_flops_per_chip
+
+        peak = peak_flops_per_chip(jax.devices()[0])
+        if peak:  # unknown device kind (e.g. CPU): stat omitted, and the
+            # one-time trace below is skipped — it could never be used.
+            # Resolution happens AFTER `elapsed` is taken: a multi-second
+            # lowering must not deflate the first epoch's rate stats.
+            if self._flops_per_update is None:
+                self._resolve_flops(train if self.device_replay is not None
+                                    else None,
+                                    None if self.device_replay is not None
+                                    else last_batch)
+            if self._flops_per_update:
+                self.stats["mfu"] = round(
+                    self._flops_per_update * batch_cnt
+                    / (elapsed * peak * self.ctx.mesh.size),
+                    6,
+                )
         self.data_cnt_ema = self.data_cnt_ema * 0.8 + data_cnt / (1e-2 + batch_cnt) * 0.2
         self.state_host = jax.device_get(self.state)
         return self.state_host["params"]
+
+    def _resolve_flops(self, replay_train, batch) -> None:
+        """One-time FLOPs-per-update resolution at the end of the first
+        trained epoch (a lowering / trace, nothing executes).  Failure
+        records 0.0 so it is never retried every epoch."""
+        try:
+            if replay_train is not None:
+                self._flops_per_update = float(
+                    replay_train.flops_per_update(self.state)
+                )
+            elif batch is not None:
+                if self.fused > 1:
+                    # stacked (k, B, ...) tree -> one batch of AVALS: a
+                    # concrete x[0] slice would dispatch multi-device
+                    # gathers outside DISPATCH_LOCK (the serialized-
+                    # dispatch invariant); the lowering only needs shapes
+                    batch = jax.tree.map(
+                        lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype),
+                        batch,
+                    )
+                self._flops_per_update = float(
+                    self.ctx.flops_per_step(self.state, batch) or 0.0
+                )
+            else:
+                self._flops_per_update = 0.0
+        except Exception:
+            self._flops_per_update = 0.0
 
     def stop(self):
         self.stop_event.set()

@@ -309,3 +309,113 @@ def test_jaxpr_flops_close_to_hlo():
         jax.make_jaxpr(ctx._step_fn)(state, batch, jnp.float32(1e-5)).jaxpr
     )
     assert 0.5 < analytic / hlo < 2.0, (analytic, hlo)
+
+
+def test_peak_flops_lookup():
+    from types import SimpleNamespace
+
+    from handyrl_tpu.parallel.train_step import peak_flops_per_chip
+
+    assert peak_flops_per_chip(SimpleNamespace(device_kind="TPU v5 lite")) == 197e12
+    assert peak_flops_per_chip(SimpleNamespace(device_kind="TPU v5p")) == 459e12
+    assert peak_flops_per_chip(SimpleNamespace(device_kind="cpu")) is None
+    assert peak_flops_per_chip(SimpleNamespace()) is None
+
+
+def test_trainer_reports_mfu_with_known_peak(monkeypatch):
+    """End of the first trained epoch resolves FLOPs/update once and, when
+    the chip's peak rate is known, emits an 'mfu' stat that rides into
+    metrics.jsonl (round-4: MFU is a product stat, not just a bench
+    extra).  The CPU host has no peak entry, so the lookup is patched."""
+    import handyrl_tpu.parallel.train_step as ts
+    from handyrl_tpu.runtime.trainer import Trainer
+
+    fake_peak = 1e12
+    monkeypatch.setattr(ts, "peak_flops_per_chip", lambda d: fake_peak)
+
+    targs = _args(batch_size=4, minimum_episodes=2, mesh={"dp": 1})
+    targs["env"] = {"env": "TicTacToe"}
+    env, module, model, eps = _gen_episodes("TicTacToe", 8, targs)
+    trainer = Trainer(targs, module, model.variables["params"], make_mesh({"dp": 1}))
+    trainer.store.extend(eps)
+    trainer.batcher.start()
+    trainer.update_flag = True  # epoch ends after the first completed update
+    try:
+        trainer.train_epoch()
+    finally:
+        trainer.stop()
+
+    assert trainer._flops_per_update and trainer._flops_per_update > 1e6, (
+        trainer._flops_per_update
+    )
+    assert "mfu" in trainer.stats and trainer.stats["mfu"] > 0
+    # mfu = flops * updates/s / peak (mesh.size == 1)
+    expect = (
+        trainer._flops_per_update
+        * trainer.stats["train_steps_per_sec"]
+        / fake_peak
+    )
+    assert abs(trainer.stats["mfu"] - expect) < max(1e-6, 0.01 * expect)
+
+
+def test_device_replay_train_fn_exposes_flops():
+    """The device-replay fused train program reports analytic FLOPs per
+    update (trace-only) for the same MFU stat."""
+    from handyrl_tpu.envs.vector_hungry_geese import VectorHungryGeese
+    from handyrl_tpu.runtime.device_replay import DeviceReplay
+
+    targs = _args(
+        "HungryGeese", batch_size=4, forward_steps=4,
+        turn_based_training=False, observation=False, mesh={"dp": 1},
+    )
+    targs["env"] = {"env": "HungryGeese"}
+    env = make_env({"env": "HungryGeese"})
+    module = env.net()
+    params = init_variables(module, env)["params"]
+    mesh = make_mesh({"dp": 1})
+    ctx = TrainContext(module, targs, mesh)
+    state = ctx.init_state(params)
+
+    replay = DeviceReplay(VectorHungryGeese, module, targs, mesh, 4, slots=64)
+    # one ingest materializes the rings (their shapes are what the trace
+    # needs; eligibility doesn't matter — nothing executes)
+    from handyrl_tpu.runtime.device_rollout import build_streaming_fn
+
+    fn = build_streaming_fn(VectorHungryGeese, module, 4, 16, mesh=None,
+                            use_observe_mask=False)
+    vstate = VectorHungryGeese.init(4, jax.random.PRNGKey(0))
+    _, _, records = fn(params, vstate, None, jax.random.PRNGKey(1))
+    replay.ingest(records)
+
+    train = replay.train_fn(ctx, fused_steps=2)
+    flops = train.flops_per_update(state)
+    assert flops > 1e6, flops
+    # per-update: doubling fused_steps must not change the number (~exact:
+    # same body, scan length divides back out)
+    flops4 = replay.train_fn(ctx, fused_steps=4).flops_per_update(state)
+    assert abs(flops - flops4) / flops < 0.05, (flops, flops4)
+
+
+def test_flops_per_step_accepts_avals():
+    """The fused-path FLOPs resolution hands flops_per_step ShapeDtypeStruct
+    leaves (a concrete slice would dispatch outside DISPATCH_LOCK); the
+    lowering must accept avals and agree with the concrete-batch count."""
+    targs = _args(batch_size=4)
+    targs["env"] = {"env": "TicTacToe"}
+    env, module, model, eps = _gen_episodes("TicTacToe", 6, targs)
+    store = EpisodeStore(100)
+    store.extend(eps)
+    windows = []
+    while len(windows) < 4:
+        w = store.sample_window(targs["forward_steps"], targs["burn_in_steps"],
+                                targs["compress_steps"])
+        if w is not None:
+            windows.append(w)
+    batch = make_batch(windows, targs)
+    ctx = TrainContext(module, targs, make_mesh({"dp": 1}))
+    state = ctx.init_state(model.variables["params"])
+    db = ctx.put_batch(batch)
+    concrete = ctx.flops_per_step(state, db)
+    avals = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), db)
+    assert concrete and concrete > 0
+    assert ctx.flops_per_step(state, avals) == concrete
