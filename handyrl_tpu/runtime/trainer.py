@@ -365,6 +365,16 @@ class Trainer:
             else:
                 self._flops_per_update = 0.0
         except Exception:
+            # degrade loudly, once: a silent 0.0 would drop the mfu stat
+            # from metrics.jsonl for the whole run with no hint why
+            import sys
+
+            traceback.print_exc(limit=2, file=sys.stderr)
+            print(
+                "[handyrl_tpu] FLOPs-per-update resolution failed (above); "
+                "metrics.jsonl will carry no 'mfu' stat this run",
+                file=sys.stderr,
+            )
             self._flops_per_update = 0.0
 
     def stop(self):
