@@ -166,8 +166,10 @@ def _devices_with_retry(retries: int = 3, delay: float = 20.0):
     import jax
 
     if os.environ.get("HANDYRL_PLATFORM") == "cpu":
-        # explicit CPU request (validation runs; same contract as main.py)
-        jax.config.update("jax_platforms", "cpu")
+        # explicit CPU request (validation runs): skip the probe entirely
+        from handyrl_tpu.utils import apply_platform_override
+
+        apply_platform_override()
         return jax.devices(), None
 
     wait_budget = _tpu_wait_budget()

@@ -1,3 +1,4 @@
+from .platform import apply_platform_override
 from .tree import (
     tree_map,
     tree_stack,
@@ -9,6 +10,7 @@ from .tree import (
 )
 
 __all__ = [
+    "apply_platform_override",
     "tree_map",
     "tree_stack",
     "tree_unstack",

@@ -1,0 +1,24 @@
+"""In-process platform override, shared by every entry point.
+
+The JAX_PLATFORMS env var alone is not reliable on hosts whose site
+customization imports jax at interpreter startup and pins a platform via
+jax.config (config beats env — e.g. the axon sitecustomize pins
+``jax_platforms=axon`` in EVERY process).  HANDYRL_PLATFORM re-pins it
+here, before the first computation: ``HANDYRL_PLATFORM=cpu`` for a
+virtual CPU mesh run of the CLI, bench, or any tools/ script.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_override() -> None:
+    """Honor ``HANDYRL_PLATFORM`` (any platform name jax accepts); no-op
+    when unset.  Must run before the first jax computation — importing
+    jax is fine, initializing a backend is not."""
+    plat = os.environ.get("HANDYRL_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)

@@ -14,15 +14,11 @@ import sys
 
 import yaml
 
-# Platform override BEFORE any backend initializes.  The JAX_PLATFORMS env
-# var alone is not reliable on hosts whose site customization imports jax at
-# interpreter startup and pins a platform via jax.config (config beats env);
-# HANDYRL_PLATFORM re-pins it here, e.g. HANDYRL_PLATFORM=cpu for a virtual
-# CPU mesh run of the full CLI.
-if os.environ.get("HANDYRL_PLATFORM"):
-    import jax
+# Platform override BEFORE any backend initializes (shared helper; see
+# handyrl_tpu/utils/platform.py for why JAX_PLATFORMS alone is not enough).
+from handyrl_tpu.utils import apply_platform_override
 
-    jax.config.update("jax_platforms", os.environ["HANDYRL_PLATFORM"])
+apply_platform_override()
 
 from handyrl_tpu.config import normalize_args
 

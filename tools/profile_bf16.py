@@ -32,6 +32,10 @@ def main() -> None:
 
     import jax
 
+    from handyrl_tpu.utils import apply_platform_override
+
+    apply_platform_override()
+
     import bench
 
     print(f"backend: {jax.default_backend()} ({jax.devices()[0].device_kind})")

@@ -33,6 +33,10 @@ import bench  # noqa: E402  (repo-root import)
 def main() -> None:
     import jax
 
+    from handyrl_tpu.utils import apply_platform_override
+
+    apply_platform_override()
+
     duration = float(sys.argv[1]) if len(sys.argv) > 1 else 8.0
     quick = bool(os.environ.get("TUNE_QUICK"))
     backend = jax.default_backend()
