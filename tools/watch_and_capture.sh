@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-4 chip watcher: probe the axon lease on a loop; the moment it
+# answers, bank the full capture (tools/capture_tpu_r4.py) and exit.
+# The probe subprocess is timeout-killed the same way bench's own
+# out-of-process probe is — it never finishes backend init on a wedged
+# lease, so there is no initialized client to wedge further.
+cd "$(dirname "$0")/.." || exit 1
+PIDFILE=/tmp/r4_watch.pid
+[ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null && { echo "watcher already running"; exit 0; }
+echo $$ > "$PIDFILE"
+while true; do
+  if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "[watch $(date -u +%H:%M:%S)] chip answered; launching capture"
+    python tools/capture_tpu_r4.py >> docs/captures/r4_capture.log 2>&1
+    echo "[watch $(date -u +%H:%M:%S)] capture finished (rc=$?)"
+    break
+  fi
+  echo "[watch $(date -u +%H:%M:%S)] probe hung/failed; retrying in 180s"
+  sleep 180
+done
+rm -f "$PIDFILE"
