@@ -206,8 +206,14 @@ def test_geister_drc_beats_random(tmp_path, monkeypatch):
     early = float(np.mean(win[:20]))
     late = float(np.mean(win[-20:]))
     # margins sized from the recorded passes (round 3: 0.569 -> 0.649,
-    # peak 0.902; on-chip run: +0.35): a floor of 0.55 with any positive
+    # peak 0.902; on-chip run: +0.35; round 4: a fast-start run reached
+    # 0.8+ inside the early window).  A floor of 0.55 with any positive
     # climb let a substantially regressed DRC path still pass, so the bar
-    # asks for a clear climb AND a 0.60 late-window mean
-    assert late > early + 0.05, f"no clear climb vs random: {early:.3f} -> {late:.3f}"
+    # asks for a 0.60 late-window mean AND either a clear climb or a
+    # decisively high late window — a fast learner must not fail merely
+    # for having nothing left to climb.
     assert late >= 0.60, f"final win rate vs random {late:.3f} (early {early:.3f})"
+    assert (late >= 0.75 and late >= early - 0.05) or late > early + 0.05, (
+        f"not climbing (or collapsed from a high start) vs random: "
+        f"{early:.3f} -> {late:.3f}"
+    )
