@@ -15,7 +15,7 @@ while true; do
     echo "[watch $(date -u +%H:%M:%S)] capture finished (rc=$?)"
     break
   fi
-  echo "[watch $(date -u +%H:%M:%S)] probe hung/failed; retrying in 180s"
-  sleep 180
+  echo "[watch $(date -u +%H:%M:%S)] probe hung/failed; retrying in 420s"
+  sleep 420
 done
 rm -f "$PIDFILE"
