@@ -1,31 +1,99 @@
-"""Skip-gated crosscheck of the standalone HungryGeese rules against the
-real Kaggle engine (tools/crosscheck_kaggle.py).
+"""Crosscheck of the standalone HungryGeese rules against the real Kaggle
+engine (tools/crosscheck_kaggle.py), plus a local validation of the
+crosscheck harness itself.
 
 The build image cannot install ``kaggle_environments`` (zero egress), so
-locally this skips; the CI extras job installs the dep and executes it,
-replacing the hand-written parity doc with a machine check (ground truth:
-the engine the reference wraps, handyrl/envs/kaggle/hungry_geese.py:67).
+the real crosscheck skips locally; the CI extras job installs the dep and
+executes it, replacing the hand-written parity doc with a machine check
+(ground truth: the engine the reference wraps,
+handyrl/envs/kaggle/hungry_geese.py:67).  Because the harness's first
+real execution is in CI, its plumbing (state injection, food sync,
+status/outcome comparison) is exercised HERE against a fake Kaggle
+module backed by a second independent instance of our own engine — a
+plumbing bug fails locally, only a genuine rules divergence can fail in
+CI.
 """
 
 import os
+import random
 import sys
+import types
 from pathlib import Path
 
 import pytest
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
 
-if os.environ.get("HANDYRL_REQUIRE_EXTRAS"):
-    # CI extras job: a missing/broken dep must FAIL there, not skip —
-    # the job exists to execute this leg
-    import kaggle_environments  # noqa: F401
-else:
-    pytest.importorskip(
-        "kaggle_environments", reason="kaggle_environments not installed"
-    )
+
+def _require_kaggle():
+    if os.environ.get("HANDYRL_REQUIRE_EXTRAS"):
+        # CI extras job: a missing/broken dep must FAIL there, not skip —
+        # the job exists to execute this leg
+        import kaggle_environments  # noqa: F401
+    else:
+        pytest.importorskip(
+            "kaggle_environments", reason="kaggle_environments not installed"
+        )
 
 
 def test_hungry_geese_matches_kaggle_engine():
+    _require_kaggle()
     from crosscheck_kaggle import crosscheck_hungry_geese
 
     crosscheck_hungry_geese(num_games=10, verbose=False)
+
+
+class _FakeKaggleEnv:
+    """Duck-types the slice of kaggle_environments' hungry_geese env the
+    crosscheck touches — reset(num_agents)/step(action_strings) returning
+    per-agent dicts with status/reward/observation — backed by our own
+    host rules, so both crosscheck sides step independent engines."""
+
+    def reset(self, num_agents: int):
+        import handyrl_tpu.envs.hungry_geese as hg
+
+        assert num_agents == 4
+        self._env = hg.Environment()
+        self._env.reset()
+        return self._obs()
+
+    def step(self, action_strings):
+        import handyrl_tpu.envs.hungry_geese as hg
+
+        actions = {
+            p: hg.ACTIONS.index(action_strings[p])
+            for p in range(4)
+            if self._env.active[p]
+        }
+        self._env.step(actions)
+        return self._obs()
+
+    def _obs(self):
+        env = self._env
+        shared = {
+            "geese": [list(g) for g in env.geese],
+            "food": list(env.food),
+        }
+        return [
+            {
+                "status": "ACTIVE" if env.active[p] else "DONE",
+                "reward": env.rank_rewards[p],
+                "observation": dict(shared, index=p) if p == 0 else {"index": p},
+            }
+            for p in range(4)
+        ]
+
+
+def test_crosscheck_harness_plumbing(monkeypatch):
+    """Run the real crosscheck loop against the fake Kaggle module: our
+    engine on both sides must come out identical, proving the harness's
+    injection/sync/compare logic (not the rules — CI does that)."""
+    fake = types.ModuleType("kaggle_environments")
+    fake.make = lambda name: (_FakeKaggleEnv() if name == "hungry_geese"
+                              else None)
+    monkeypatch.setitem(sys.modules, "kaggle_environments", fake)
+
+    from crosscheck_kaggle import crosscheck_hungry_geese
+
+    random.seed(202)  # the fake engine's reset/food draws use global random
+    crosscheck_hungry_geese(num_games=5, verbose=False)

@@ -12,7 +12,8 @@ while true; do
   if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[watch $(date -u +%H:%M:%S)] chip answered; launching capture"
     python tools/capture_tpu_r4.py >> docs/captures/r4_capture.log 2>&1
-    echo "[watch $(date -u +%H:%M:%S)] capture finished (rc=$?)"
+    rc=$?
+    echo "[watch $(date -u +%H:%M:%S)] capture finished (rc=$rc)"
     break
   fi
   echo "[watch $(date -u +%H:%M:%S)] probe hung/failed; retrying in 420s"
