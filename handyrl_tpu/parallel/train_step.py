@@ -295,19 +295,26 @@ class TrainContext:
 
         cdt = _compute_dtype(args)
         if cdt is not None and not getattr(module, "supports_seq", False):
-            # Measured on the v5e (BENCH r2): bf16 is ~2.9x SLOWER than fp32
-            # for the small-conv game nets (7x11 boards, 32 channels) — the
-            # per-conv layout/convert overhead dwarfs the MXU-rate gain at
-            # these shapes.  The knob stays honored (the transformer family
-            # is where it pays); warn so a config doesn't silently regress.
-            import sys
+            # bf16 on the small-conv game nets, settled by the round-4
+            # dispatch-amortized on-chip profile (tools/profile_bf16.py,
+            # K=32 fused, v5e, 2026-08-01): device math is PARITY — fp32
+            # 3.05 ms/update vs bf16 2.93 (1.04x) at geese shapes; the
+            # round-2 "2.9x slower" was a dispatch-bound measurement, not
+            # kernel time.  bf16 additionally wins whenever transfers
+            # dominate (smaller copies).  XLA:CPU is the real regression
+            # (~0.46x: convert ops don't fuse there) — warn only there,
+            # judged by the mesh that will actually run the step (a CPU
+            # mesh on a TPU host still hits the CPU regression).
+            if mesh.devices.flat[0].platform == "cpu":
+                import sys
 
-            print(
-                "[handyrl_tpu] compute_dtype=bfloat16 on a conv game net: "
-                "measured SLOWER than float32 at these layer shapes on TPU "
-                "(see BASELINE.md); verify with bench.py before keeping it",
-                file=sys.stderr,
-            )
+                print(
+                    "[handyrl_tpu] compute_dtype=bfloat16 on a conv game "
+                    "net under XLA:CPU: measured ~2x SLOWER than float32 "
+                    "(unfused convert ops); on TPU it is parity-or-better "
+                    "(see BASELINE.md bf16 row)",
+                    file=sys.stderr,
+                )
 
         def _loss_fn(params, batch):
             # mixed precision: bf16 copies feed the forward, fp32 master

@@ -49,7 +49,12 @@ def main() -> None:
     print(f"[{time.time()-t0:.0f}s] store filled", flush=True)
 
     try:
-        ns2 = bench._device_replay_northstar_bench(gt, 12.0)
+        # r3 geometry pinned explicitly (the bench defaults moved to the
+        # r4 sweep's tuned point); this tool reproduces the r3 rows
+        ns2 = bench._device_replay_northstar_bench(
+            gt, 12.0, n_lanes=256, k_steps=32, fused_steps=8,
+            trains_per_rollout=2,
+        )
         out["stages"]["northstar2"] = ns2
         print(f"[{time.time()-t0:.0f}s] northstar2: {ns2}", flush=True)
     except Exception:
