@@ -225,11 +225,14 @@ def _peak_flops(device) -> float | None:
     return peak_flops_per_chip(device)
 
 
-def _make_args(env_name: str, overrides=None):
+def _make_args(env_name: str, overrides=None, env_overrides=None):
     from handyrl_tpu.config import normalize_args
 
     cfg = normalize_args(
-        {"env_args": {"env": env_name}, "train_args": dict(overrides or {})}
+        {
+            "env_args": {"env": env_name, **(env_overrides or {})},
+            "train_args": dict(overrides or {}),
+        }
     )
     args = dict(cfg["train_args"])
     args["env"] = cfg["env_args"]
@@ -305,7 +308,8 @@ def _sig(x, digits: int = 3):
 
 
 def _train_bench(env_name: str, overrides, duration: float, n_devices: int,
-                 fill_episodes: int = 48, fused: bool = False, reuse=None):
+                 fill_episodes: int = 48, fused: bool = False, reuse=None,
+                 env_overrides=None):
     """Timed jitted-train-step loop on pre-staged device batches.
 
     Returns updates/s, trained env-steps/s, flops/step (XLA cost analysis).
@@ -315,7 +319,7 @@ def _train_bench(env_name: str, overrides, duration: float, n_devices: int,
 
     from handyrl_tpu.parallel import TrainContext, make_mesh
 
-    args = _make_args(env_name, overrides)
+    args = _make_args(env_name, overrides, env_overrides)
     if args["batch_size"] % n_devices:
         args["batch_size"] = max(n_devices, args["batch_size"] // n_devices * n_devices)
 
@@ -920,6 +924,36 @@ def _flash_attention_bench(duration: float = 3.0):
     }
 
 
+KNOWN_STAGES = (
+    "tictactoe", "device-selfplay", "geese-device-selfplay", "geese-gen",
+    "geese-train", "northstar", "northstar2", "geese-bf16", "geister",
+    "geister-device-selfplay", "geister-devreplay", "transformer", "flash",
+)
+# stages that consume another stage's result (main() gates them on it)
+STAGE_DEPS = {
+    "northstar": ("geese-train",),
+    "northstar2": ("geese-train",),
+    "geese-bf16": ("geese-train",),
+}
+
+
+def _stage_filter() -> Optional[set]:
+    """``BENCH_STAGES=a,b,c`` limits the run to the named stages (for
+    banking one new stage's numbers on a live chip without re-paying the
+    full ~25 min suite).  Unset or empty means all stages — an empty
+    string from CI interpolation must not skip everything.  Dependencies
+    are pulled in automatically (BENCH_STAGES=northstar2 also runs
+    geese-train: the northstar/bf16 stages reuse its store + context and
+    are gated on its result in main())."""
+    raw = os.environ.get("BENCH_STAGES")
+    if raw is None or not raw.strip():
+        return None
+    names = {s.strip() for s in raw.split(",") if s.strip()}
+    for n in tuple(names):
+        names.update(STAGE_DEPS.get(n, ()))
+    return names
+
+
 def _run_stage(result: dict, name: str, fn, retries: int = 1,
                retry_delay: float = 20.0):
     """Run one bench stage with a single retry.  One transient failure
@@ -932,6 +966,10 @@ def _run_stage(result: dict, name: str, fn, retries: int = 1,
     recording throughput must not leave numbers that read as measured),
     and every attempt's traceback is kept.  Returns the stage's value, or
     None after final failure."""
+    only = _stage_filter()
+    if only is not None and name not in only:
+        result["extra"].setdefault("stages_skipped", []).append(name)
+        return None
     errs = []
     for attempt in range(retries + 1):
         snap = {k: result[k] for k in ("value", "vs_baseline", "error")}
@@ -961,6 +999,17 @@ def main() -> None:
         "error": None,
         "extra": {},
     }
+
+    # a typo'd BENCH_STAGES must not burn a scarce lease window on a run
+    # that silently skips everything: unknown names fail before the probe
+    only = _stage_filter()
+    if only and not only.issubset(KNOWN_STAGES):
+        result["error"] = (
+            f"unknown BENCH_STAGES name(s) {sorted(only - set(KNOWN_STAGES))}; "
+            f"valid: {', '.join(KNOWN_STAGES)}"
+        )
+        print(json.dumps(result))
+        return
 
     done = threading.Event()
 
@@ -1227,6 +1276,75 @@ def main() -> None:
         )
         if not gdr["loss_finite"]:
             result["error"] = (result["error"] or "") + " geister-devreplay: non-finite loss"
+
+    # 4d. MXU-saturation probe: the generic transformer family
+    # (models/transformer.py) scaled to matmul-dominated shapes via
+    # env_args.net_args, through the SAME TrainContext path as every other
+    # stage — real env (Geister windows, ~full-length episodes), real
+    # losses, Adam, whole-window flash attention, bf16 compute with fp32
+    # master weights.  The game-net MFUs (tictactoe/geese/northstar2) are
+    # honest-but-tiny because those convs are tiny; this stage states the
+    # framework's MFU where the model actually offers the MXU work.
+    def stage_transformer():
+        import jax
+
+        on_tpu = jax.default_backend() == "tpu"
+        if on_tpu:
+            # shapes from the 2026-08-01 v5e sweep (tools/tune_transformer.py):
+            # T64 windows amortize the step's fixed ops best (d768: MFU 0.311
+            # vs 0.253 at T32), doubling batch was flat (0.247 — already
+            # device-bound at B64), and widening to d1024 lifts the matmul
+            # share further: MFU 0.347 at 13.5 updates/s
+            net_args = {"d_model": 1024, "n_heads": 16, "n_layers": 8,
+                        "memory_len": 32}
+            t_over = {"batch_size": 64, "burn_in_steps": 2,
+                      "forward_steps": 62, "observation": True,
+                      "compute_dtype": "bfloat16", "seq_attention": "flash"}
+        else:
+            # tiny-shape coverage of the identical code path (einsum
+            # attention: the Pallas kernel is TPU-only)
+            net_args = {"d_model": 96, "n_heads": 4, "n_layers": 2,
+                        "memory_len": 16}
+            t_over = {"batch_size": 8, "burn_in_steps": 2,
+                      "forward_steps": 14, "observation": True,
+                      "seq_attention": "einsum"}
+        # no fused variant: the k-step lax.scan of this big step compiled
+        # to a SLOWER per-update program than the pipelined single-dispatch
+        # loop (19.8 vs 35.1 updates/s, v5e 2026-08-01) and costs a second
+        # multi-minute compile — dispatch amortization only pays when the
+        # step is dispatch-bound, i.e. the tiny game nets
+        tr = _train_bench(
+            "Geister", t_over, T_TRAIN, n_dev,
+            fill_episodes=8,
+            env_overrides={"net": "transformer", "net_args": net_args},
+        )
+        result["extra"]["transformer_net"] = (
+            f"d{net_args['d_model']} L{net_args['n_layers']} "
+            f"H{net_args['n_heads']} T{t_over['burn_in_steps'] + t_over['forward_steps']} "
+            f"B{t_over['batch_size']}x2p "
+            + ("bf16" if t_over.get("compute_dtype") else "fp32")
+        )
+        result["extra"]["transformer_updates_per_sec"] = _sig(tr["updates_per_sec"])
+        ups = tr["updates_per_sec"]
+        tokens = (t_over["batch_size"] * 2
+                  * (t_over["burn_in_steps"] + t_over["forward_steps"]))
+        result["extra"]["transformer_tokens_per_sec"] = _sig(ups * tokens, 4)
+        if tr["flops_per_step"]:
+            result["extra"]["transformer_flops_per_step"] = tr["flops_per_step"]
+            if peak:
+                result["extra"]["transformer_mfu"] = _sig(
+                    tr["flops_per_step"] * ups / (peak * n_dev)
+                )
+            else:
+                result["extra"]["transformer_mfu"] = None
+                result["extra"]["transformer_mfu_note"] = (
+                    "no peak-FLOPs table entry for device kind "
+                    f"'{getattr(devices[0], 'device_kind', '?')}'"
+                )
+        else:
+            result["extra"]["transformer_mfu"] = None
+            result["extra"]["transformer_mfu_note"] = "no flops from any lowering"
+    _run_stage(result, "transformer", stage_transformer)
 
     # 5. seq-attention kernel crossover (einsum vs Pallas flash, fwd+bwd)
     def stage_flash():

@@ -113,13 +113,18 @@ class BaseEnvironment:
 
         Honors ``env_args['net'] == 'transformer'`` for every environment:
         the generic KV-cache memory family (models/transformer.py) sized by
-        ``transformer_spec()``.  Environments implement ``default_net()``
-        for their bespoke architecture.
+        ``transformer_spec()``, with ``env_args['net_args']`` merged over
+        the spec — so configs can scale the family (d_model, n_layers,
+        n_heads, memory_len, mlp_ratio) without a new env subclass.
+        Environments implement ``default_net()`` for their bespoke
+        architecture.
         """
         if self.args.get("net") == "transformer":
             from ..models import TransformerNet
 
-            return TransformerNet(**self.transformer_spec())
+            spec = dict(self.transformer_spec())
+            spec.update(self.args.get("net_args") or {})
+            return TransformerNet(**spec)
         return self.default_net()
 
     def default_net(self):

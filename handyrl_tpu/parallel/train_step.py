@@ -275,6 +275,25 @@ class TrainContext:
                     f"+ forward_steps) must be divisible by the 'sp' axis "
                     f"size {sp}"
                 )
+        # fail fast at construction, not mid-training in a learner thread:
+        # under turn-based training, stateful models (RNN hidden or
+        # KV-cache) train on all-player windows, which only exist when
+        # every player's observation is recorded (the forward asserts the
+        # same on batch shapes).  Simultaneous-move configs
+        # (turn_based_training: false) are exempt: their single-player
+        # windows observe the target player every step, so the hidden
+        # carry is well-defined without the flag.
+        if (
+            module.initial_state((1, 1)) is not None
+            and args.get("turn_based_training", True)
+            and not args.get("observation")
+        ):
+            raise ValueError(
+                "recurrent/memory models (RNN hidden or KV-cache transformer) "
+                "under turn-based training require train_args.observation: "
+                "true — per-step observations for every player are needed to "
+                "build their all-player training windows"
+            )
         self.mesh = mesh
         self.tx = make_optimizer()
         self._replicated = replicated_sharding(mesh)

@@ -133,6 +133,41 @@ def test_run_stage_retry_succeeds_and_keeps_writes(_fast_sleep):
     assert result["extra"] == {"rate": 42.0}
 
 
+def test_stage_filter_parsing(monkeypatch):
+    monkeypatch.delenv("BENCH_STAGES", raising=False)
+    assert bench._stage_filter() is None
+    # set-but-empty (CI interpolation) means all stages, not none
+    monkeypatch.setenv("BENCH_STAGES", "")
+    assert bench._stage_filter() is None
+    monkeypatch.setenv("BENCH_STAGES", "transformer, flash")
+    assert bench._stage_filter() == {"transformer", "flash"}
+
+
+def test_stage_filter_expands_dependencies(monkeypatch):
+    """BENCH_STAGES=northstar2 must also run geese-train: the dependent
+    stages are gated on its result in main() and would otherwise be
+    silently skipped with no numbers and no note."""
+    monkeypatch.setenv("BENCH_STAGES", "northstar2")
+    assert bench._stage_filter() == {"northstar2", "geese-train"}
+    # the dependency map only names real stages
+    for k, deps in bench.STAGE_DEPS.items():
+        assert k in bench.KNOWN_STAGES
+        assert set(deps) <= set(bench.KNOWN_STAGES)
+
+
+def test_stage_filter_skips_unlisted_stages(monkeypatch, _fast_sleep):
+    """With BENCH_STAGES set, unlisted stages never run (their fn is not
+    called) and are recorded in extra.stages_skipped; listed ones run."""
+    monkeypatch.setenv("BENCH_STAGES", "keep")
+    result = {"value": None, "vs_baseline": None, "error": None, "extra": {}}
+    ran = []
+    assert bench._run_stage(result, "drop", lambda: ran.append("drop")) is None
+    assert bench._run_stage(result, "keep", lambda: ran.append("keep") or "ok") == "ok"
+    assert ran == ["keep"]
+    assert result["extra"]["stages_skipped"] == ["drop"]
+    assert result["error"] is None
+
+
 def test_sig_preserves_small_rates():
     assert bench._sig(0.0021234) == 0.00212
     assert bench._sig(None) is None

@@ -44,6 +44,45 @@ def test_transformer_step_and_memory():
     assert not np.allclose(out_fresh["policy"], out_mem["policy"], atol=1e-4)
 
 
+def test_transformer_net_args_override():
+    """env_args['net_args'] scales the family without a new env subclass
+    (the bench's MXU-saturation stage and scale configs rely on this)."""
+    env, module, model = _model({
+        "env": "Geister", "net": "transformer",
+        "net_args": {"d_model": 32, "n_heads": 2, "n_layers": 3,
+                     "memory_len": 8},
+    })
+    assert isinstance(module, TransformerNet)
+    assert (module.d_model, module.n_heads, module.n_layers,
+            module.memory_len) == (32, 2, 3, 8)
+    assert module.with_return  # env's spec survives the merge
+    env.reset()
+    out = model.inference(env.observation(0), model.init_hidden())
+    assert out["policy"].shape == (env.action_size(),)
+    assert len(out["hidden"]["layers"]) == 3
+
+
+def test_stateful_model_without_observation_fails_fast():
+    """A recurrent/memory model with observation: false must be rejected
+    at TrainContext construction (clear startup error), not crash a
+    learner thread mid-training on batch shapes (found by driving
+    main.py --train with a transformer config missing the flag)."""
+    from handyrl_tpu.parallel import TrainContext, make_mesh
+
+    cfg = normalize_args(
+        {
+            "env_args": {"env": "TicTacToe", "net": "transformer"},
+            "train_args": {"batch_size": 8, "forward_steps": 4},
+        }
+    )
+    args = dict(cfg["train_args"])
+    args["env"] = cfg["env_args"]
+    assert not args.get("observation")
+    env = make_env(args["env"])
+    with pytest.raises(ValueError, match="observation: true"):
+        TrainContext(env.net(), args, make_mesh(args["mesh"]))
+
+
 def test_transformer_ring_wraparound():
     env, module, model = _model({"env": "TicTacToe", "net": "transformer"})
     env.reset()
