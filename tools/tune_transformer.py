@@ -8,6 +8,8 @@ shape the bench stage pins; run standalone whenever the lease is live:
 
     python tools/tune_transformer.py            # full sweep (~15 min)
     TUNE_T=6 python tools/tune_transformer.py   # shorter timed windows
+    TUNE_ONLY=d1024_B64_T64_bf16,d1024_B64_T64_einsum \
+        python tools/tune_transformer.py        # named variants only
 """
 
 from __future__ import annotations
@@ -36,6 +38,13 @@ VARIANTS = [
      | {"batch_size": 64, "forward_steps": 30}, D768),                          # 0.247
     ("d1024_B64_T64_bf16", {**BASE, "batch_size": 64, "forward_steps": 62},
      D1024),                                                                    # 0.347
+    # fp32 ~= bf16 at these shapes says the step is not matmul-dtype-bound;
+    # candidate culprit is the flash kernel at SHORT windows (it proved
+    # itself at T1024; at T64/window-32 the O(T^2) einsum is tiny and
+    # XLA-fusable) — this variant settles flash-vs-einsum on the pinned shape
+    ("d1024_B64_T64_einsum",
+     {**BASE, "seq_attention": "einsum", "batch_size": 64, "forward_steps": 62},
+     D1024),
 ]
 
 
@@ -54,6 +63,15 @@ def _rebuild_net(reuse, net_args):
 
 def main() -> None:
     duration = float(os.environ.get("TUNE_T", "8"))
+    # validate the variant filter BEFORE any jax/device touch: a typo must
+    # not cost a backend init (which hangs outright on a wedged lease)
+    raw_only = os.environ.get("TUNE_ONLY", "").strip()
+    only = {s.strip() for s in raw_only.split(",") if s.strip()} or None
+    if only:
+        unknown = only - {name for name, _, _ in VARIANTS}
+        if unknown:
+            sys.exit(f"unknown TUNE_ONLY variant(s): {sorted(unknown)}")
+
     import jax
 
     dev = jax.devices()[0]
@@ -63,6 +81,8 @@ def main() -> None:
     reuse = None
     prev_net = None
     for name, over, net_args in VARIANTS:
+        if only and name not in only:
+            continue
         if reuse is not None and net_args != prev_net:
             reuse = _rebuild_net(reuse, net_args)
         r = bench._train_bench(

@@ -1,0 +1,24 @@
+#!/bin/bash
+# Chip watcher for the flash-vs-einsum question on the pinned transformer
+# shape (tools/tune_transformer.py d1024 variants): probe the axon lease
+# on a loop; when it answers, bank both variants in one session (same-hour
+# like-for-like) and exit.  The probe subprocess is timeout-killed before
+# backend init completes on a wedged lease, so there is no initialized
+# client to wedge further (same pattern as watch_and_capture.sh).
+cd "$(dirname "$0")/.." || exit 1
+PIDFILE=/tmp/attn_mode_watch.pid
+[ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null && { echo "watcher already running"; exit 0; }
+echo $$ > "$PIDFILE"
+while true; do
+  if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "[watch $(date -u +%H:%M:%S)] chip answered; running attn-mode comparison"
+    TUNE_ONLY=d1024_B64_T64_bf16,d1024_B64_T64_einsum \
+      python tools/tune_transformer.py >> docs/captures/attn_mode_watch.log 2>&1
+    rc=$?
+    echo "[watch $(date -u +%H:%M:%S)] comparison finished (rc=$rc)"
+    break
+  fi
+  echo "[watch $(date -u +%H:%M:%S)] probe hung/failed; retrying in 420s"
+  sleep 420
+done
+rm -f "$PIDFILE"
