@@ -924,6 +924,17 @@ def _flash_attention_bench(duration: float = 3.0):
     }
 
 
+# the transformer stage's on-chip shape (module-level so CI can trace the
+# EXACT program the driver bench will compile on the TPU — the stage is
+# TPU-gated, so without that trace a shape bug would first surface
+# mid-capture; tests/test_transformer.py::test_bench_tpu_transformer_config_traces)
+TRANSFORMER_TPU_NET_ARGS = {"d_model": 1024, "n_heads": 16, "n_layers": 8,
+                            "memory_len": 32}
+TRANSFORMER_TPU_OVERRIDES = {"batch_size": 64, "burn_in_steps": 2,
+                             "forward_steps": 62, "observation": True,
+                             "compute_dtype": "bfloat16",
+                             "seq_attention": "flash"}
+
 KNOWN_STAGES = (
     "tictactoe", "device-selfplay", "geese-device-selfplay", "geese-gen",
     "geese-train", "northstar", "northstar2", "geese-bf16", "geister",
@@ -1295,11 +1306,8 @@ def main() -> None:
             # vs 0.253 at T32), doubling batch was flat (0.247 — already
             # device-bound at B64), and widening to d1024 lifts the matmul
             # share further: MFU 0.347 at 13.5 updates/s
-            net_args = {"d_model": 1024, "n_heads": 16, "n_layers": 8,
-                        "memory_len": 32}
-            t_over = {"batch_size": 64, "burn_in_steps": 2,
-                      "forward_steps": 62, "observation": True,
-                      "compute_dtype": "bfloat16", "seq_attention": "flash"}
+            net_args = TRANSFORMER_TPU_NET_ARGS
+            t_over = dict(TRANSFORMER_TPU_OVERRIDES)
         else:
             # tiny-shape coverage of the identical code path (einsum
             # attention: the Pallas kernel is TPU-only)
