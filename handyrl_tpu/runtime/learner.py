@@ -194,6 +194,18 @@ class Learner:
                     f"for this vector env; evaluating vs 'random' instead"
                 )
                 opp = "random"
+            # fail at startup, not at the first epoch boundary inside the
+            # eval thread: device eval drives the STREAMING contract
+            # (reset_done/step/legal_mask_all); episodic twins
+            # (VectorTicTacToe-style, e.g. the Connect Four example) don't
+            # have it
+            if not (hasattr(venv, "reset_done") and hasattr(venv, "step")):
+                raise ValueError(
+                    f"device_eval_games needs a streaming vector env "
+                    f"(reset_done/step hooks); "
+                    f"{getattr(venv, '__name__', type(venv).__name__)} is "
+                    "episodic — use host eval workers for this env"
+                )
             from .device_eval import DeviceEvaluator
 
             mesh = self.trainer.ctx.mesh

@@ -125,6 +125,30 @@ def test_eval_stream_fn_rejects_unknown_opponent():
         build_eval_stream_fn(VectorHungryGeese, module, 8, 8, opponent="self")
 
 
+def test_learner_device_eval_rejects_episodic_twin(tmp_path, monkeypatch):
+    """device_eval_games with an episodic vector env (no streaming
+    reset_done/step hooks — VectorTicTacToe, the Connect Four example)
+    must fail at Learner construction with the limitation named, not
+    AttributeError inside the eval thread at the first epoch boundary."""
+    from handyrl_tpu.runtime.learner import Learner
+
+    monkeypatch.chdir(tmp_path)
+    cfg = normalize_args({
+        "env_args": {"env": "TicTacToe"},
+        "train_args": {
+            "batch_size": 8,
+            "forward_steps": 8,
+            "epochs": 1,
+            "eval_rate": 0.0,
+            "device_rollout_games": 8,
+            "device_eval_games": 8,
+            "worker": {"num_parallel": 1},
+        },
+    })
+    with pytest.raises(ValueError, match="episodic"):
+        Learner(cfg)
+
+
 def test_learner_device_eval_records_curve(tmp_path, monkeypatch):
     """A device_replay run with device_eval_games must record a win_rate
     entry EVERY epoch — the host-worker curve starves on slow hosts (the
