@@ -96,6 +96,7 @@ _TRAIN_CHILD = r"""
 import json, os, sys
 
 port, pid, nproc, outdir = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+mesh_spec = json.loads(sys.argv[5]) if len(sys.argv) > 5 else {"dp": -1}
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 import jax
@@ -114,7 +115,7 @@ sys.path.insert(0, os.getcwd())  # parent sets cwd to the tests dir
 from test_multihost import build_ttt_batch, run_one_train_step
 
 batch, module, params, args = build_ttt_batch()
-mesh = make_mesh({"dp": -1})
+mesh = make_mesh(mesh_spec)
 B_local = batch["action"].shape[0] // nproc
 local = jax.tree.map(lambda x: x[pid * B_local:(pid + 1) * B_local], batch)
 new_params, loss = run_one_train_step(module, args, mesh, params, local)
@@ -174,7 +175,13 @@ def build_ttt_batch():
 
 
 def run_one_train_step(module, args, mesh, params, local_batch):
-    """One real TrainContext.train_step; returns (host params, loss)."""
+    """One real TrainContext.train_step; returns (host params, loss).
+
+    Params are re-laid-out replicated before the host fetch: under an
+    'mp' mesh axis the updated kernels are SHARDED across the global
+    devices, and in a multi-process run device_get of a partially
+    non-addressable array fails — the jitted identity performs the
+    all-gather (a no-op when already replicated)."""
     import jax
     import numpy as np
 
@@ -184,7 +191,8 @@ def run_one_train_step(module, args, mesh, params, local_batch):
     state = ctx.init_state(params)
     device_batch = ctx.put_batch(local_batch)
     state, metrics = ctx.train_step(state, device_batch, 1e-3)
-    host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), state["params"])
+    gathered = jax.jit(lambda t: t, out_shardings=ctx._replicated)(state["params"])
+    host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), gathered)
     return host, float(jax.device_get(metrics["total"]))
 
 
@@ -225,14 +233,16 @@ def test_two_process_cpu_distributed(tmp_path):
     assert not (tmp_path / "noncoord_0.txt").exists()
 
 
-@pytest.mark.slow
-def test_two_process_train_step(tmp_path):
-    """TrainContext.train_step under jax.distributed: 2 processes x 2
-    virtual devices each run the REAL jitted sharded update on their local
-    batch shard.  Both processes must end with identical params, and those
-    params must match a single-process update on the full batch (the GSPMD
-    gradient all-reduce across processes computes the same mean gradient a
-    single process computes locally, up to float reassociation)."""
+def _two_process_train_and_compare(tmp_path, mesh_spec: str, exact_cross: bool):
+    """Spawn 2 jax.distributed processes x 2 virtual devices running the
+    REAL jitted sharded update on local batch shards under ``mesh_spec``,
+    then assert (a) both processes end with the same params and (b) those
+    params match a single-process update on the full batch (same math up
+    to float reassociation — the sharded program's reduction order may
+    differ, so the cross-process check is exact only for the replicated
+    dp layout)."""
+    import json
+
     import numpy as np
 
     port = _free_port()
@@ -242,7 +252,8 @@ def test_two_process_train_step(tmp_path):
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _TRAIN_CHILD, str(port), str(pid), "2", str(tmp_path)],
+            [sys.executable, "-c", _TRAIN_CHILD, str(port), str(pid), "2",
+             str(tmp_path), mesh_spec],
             env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)),
             stdout=subprocess.PIPE,
@@ -263,10 +274,15 @@ def test_two_process_train_step(tmp_path):
         key=lambda s: int(s.split("_")[1]),  # arr_0..arr_N in leaf order
     )
     assert keys, "child dumped no param leaves"
-    # identical across processes (same global program, replicated params)
+    # identical across processes (same global program)
     for k in keys:
-        np.testing.assert_array_equal(dumps[0][k], dumps[1][k], err_msg=k)
-    assert float(dumps[0]["loss"]) == float(dumps[1]["loss"])
+        if exact_cross:
+            np.testing.assert_array_equal(dumps[0][k], dumps[1][k], err_msg=k)
+        else:
+            np.testing.assert_allclose(
+                dumps[0][k], dumps[1][k], rtol=1e-6, atol=1e-8, err_msg=k
+            )
+    assert abs(float(dumps[0]["loss"]) - float(dumps[1]["loss"])) < 1e-6
 
     # and equal to the single-process update on the full batch — pinned to
     # the children's CPU backend (a TPU-backend parent would compare
@@ -280,10 +296,10 @@ def test_two_process_train_step(tmp_path):
     ref_params, ref_loss = run_one_train_step(
         module, args, make_mesh({"dp": 1}), params, batch
     )
-    ref_leaves = [np.asarray(x) for x in __import__("jax").tree.leaves(ref_params)]
+    ref_leaves = [np.asarray(x) for x in jax.tree.leaves(ref_params)]
     assert len(ref_leaves) == len(keys)
     changed = False
-    init_leaves = [np.asarray(x) for x in __import__("jax").tree.leaves(params)]
+    init_leaves = [np.asarray(x) for x in jax.tree.leaves(params)]
     for k, ref, init in zip(keys, ref_leaves, init_leaves):
         np.testing.assert_allclose(
             dumps[0][k], ref, rtol=2e-4, atol=2e-6, err_msg=k
@@ -291,3 +307,21 @@ def test_two_process_train_step(tmp_path):
         changed = changed or not np.array_equal(ref, init)
     assert changed, "update was a no-op: params identical to init"
     assert abs(float(dumps[0]["loss"]) - ref_loss) < 1e-4 * max(1.0, abs(ref_loss))
+
+
+@pytest.mark.slow
+def test_two_process_train_step(tmp_path):
+    """TrainContext.train_step under jax.distributed on the replicated-dp
+    layout: identical params on both processes (bit-exact) and match vs
+    the single-process update (SURVEY §2.5's gradient-plane claim)."""
+    _two_process_train_and_compare(tmp_path, '{"dp": -1}', exact_cross=True)
+
+
+@pytest.mark.slow
+def test_two_process_train_step_tensor_parallel(tmp_path):
+    """The same claim with a tensor-parallel axis spanning the global mesh:
+    dp=2 x mp=2 over 2 processes — kernels sharded over 'mp', batch over
+    'dp', GSPMD's cross-process collectives doing both the gradient
+    all-reduce and the tp gathers.  Params are all-gathered before the
+    dump (see run_one_train_step)."""
+    _two_process_train_and_compare(tmp_path, '{"dp": 2, "mp": 2}', exact_cross=False)
