@@ -125,6 +125,112 @@ np.savez(os.path.join(outdir, f"params_{pid}.npz"), loss=loss, *leaves)
 """
 
 
+# Sequence-parallel plane across processes: masked ring attention with T
+# sharded over an 'sp' axis spanning the 2-process global mesh — the K/V
+# ring's ppermute hops cross process boundaries.  Inputs are seeded
+# identically everywhere; each process contributes its local T rows via
+# make_array_from_process_local_data, and the sharded output is
+# all-gathered and dumped for comparison against the single-process
+# einsum reference.
+_RING_CHILD = r"""
+import os, sys
+
+port, pid, nproc, outdir = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from handyrl_tpu.ops import masked_ring_self_attention
+from handyrl_tpu.parallel import init_distributed, make_mesh
+
+init_distributed(
+    {"coordinator_address": f"127.0.0.1:{port}", "num_processes": nproc, "process_id": pid}
+)
+
+sys.path.insert(0, os.getcwd())
+from test_multihost import build_ring_inputs
+
+q, k, v, key_mask, slopes, window = build_ring_inputs()
+mesh = make_mesh({"sp": -1})
+T = q.shape[1]
+T_proc = T // nproc
+
+def put(x, spec):
+    sh = NamedSharding(mesh, spec)
+    local = x[:, pid * T_proc:(pid + 1) * T_proc]
+    return jax.make_array_from_process_local_data(sh, np.asarray(local))
+
+qg = put(q, P(None, "sp", None, None))
+kg = put(k, P(None, "sp", None, None))
+vg = put(v, P(None, "sp", None, None))
+mg = put(key_mask, P(None, "sp"))
+
+out = masked_ring_self_attention(qg, kg, vg, mg, jax.numpy.asarray(slopes), mesh, window=window)
+rep = jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))(out)
+np.savez(os.path.join(outdir, f"ring_{pid}.npz"), out=np.asarray(jax.device_get(rep)))
+"""
+
+
+def build_ring_inputs():
+    """Deterministic (q, k, v, key_mask, slopes, window) for the ring test —
+    same values in every process (fixed PRNG keys, host numpy)."""
+    import numpy as np
+
+    rng = np.random.RandomState(99)
+    B, T, H, D = 2, 32, 2, 8
+    q = rng.randn(B, T, H, D).astype(np.float32)
+    k = rng.randn(B, T, H, D).astype(np.float32)
+    v = rng.randn(B, T, H, D).astype(np.float32)
+    key_mask = (rng.rand(B, T) < 0.7).astype(np.float32)
+    slopes = (2.0 ** -np.arange(1, H + 1)).astype(np.float32)
+    return q, k, v, key_mask, slopes, 8
+
+
+@pytest.mark.slow
+def test_two_process_ring_attention(tmp_path):
+    """Masked ring attention with the 'sp' axis spanning 2 processes must
+    match the single-process einsum reference — the sequence-parallel
+    plane's cross-host claim (its ppermute ring hops process boundaries)."""
+    import numpy as np
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _RING_CHILD, str(port), str(pid), "2", str(tmp_path)],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=300)[0].decode(errors="replace") for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"child failed:\n{out}"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from handyrl_tpu.ops.flash_attention import masked_attention_reference
+
+    q, k, v, key_mask, slopes, window = build_ring_inputs()
+    ref = np.asarray(
+        masked_attention_reference(q, k, v, key_mask, slopes, window=window)
+    )
+    for pid in range(2):
+        got = np.load(tmp_path / f"ring_{pid}.npz")["out"]
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-5)
+
+
 def build_ttt_batch():
     """Deterministic TicTacToe batch + module + init params (seeded global
     RNGs: every caller that seeds the same way gets byte-identical data)."""
