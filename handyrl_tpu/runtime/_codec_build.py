@@ -1,0 +1,76 @@
+"""Compile-on-first-use loader for the codec C accelerator.
+
+No install step: the extension (`_codec_accel.c`) is compiled with the
+plain system compiler into a per-ABI cache next to the package (or under
+``~/.cache/handyrl_tpu`` when the package dir is read-only) and loaded
+from there; subsequent imports hit the cached .so.  Any failure —
+no compiler, sandboxed filesystem, exotic platform — raises, and
+codec.py falls back to the pure-Python implementation, so the
+accelerator is strictly optional.
+
+Concurrent builders (e.g. worker processes starting together) compile to
+a unique temp file and atomically rename it into place.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import os
+import subprocess
+import sysconfig
+import tempfile
+from pathlib import Path
+
+_SRC = Path(__file__).with_name("_codec_accel.c")
+
+
+def _cache_dir() -> Path:
+    pkg = _SRC.parent
+    if os.access(pkg, os.W_OK):
+        return pkg
+    root = Path(os.environ.get("XDG_CACHE_HOME", Path.home() / ".cache"))
+    d = root / "handyrl_tpu"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _so_path() -> Path:
+    """Per-ABI, per-SOURCE-CONTENT cache name: embedding the source hash
+    makes stale-binary loads impossible (mtime comparison is unreliable —
+    package managers preserve archive mtimes, and a shared ~/.cache can
+    hold a .so built from another checkout's older source)."""
+    tag = sysconfig.get_config_var("SOABI") or "abi3"
+    digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:12]
+    return _cache_dir() / f"_codec_accel.{tag}.{digest}.so"
+
+
+def _compile(so: Path) -> None:
+    cc = os.environ.get("CC", "cc")
+    include = sysconfig.get_paths()["include"]
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(so.parent))
+    os.close(fd)
+    try:
+        subprocess.run(
+            [cc, "-O2", "-shared", "-fPIC", f"-I{include}", str(_SRC),
+             "-o", tmp],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, so)  # atomic: racing builders both win
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load():
+    """Import the accelerator, compiling it first if needed (raises on any
+    failure; the caller falls back to pure Python)."""
+    so = _so_path()
+    if not so.exists():
+        _compile(so)
+    spec = importlib.util.spec_from_file_location("handyrl_tpu.runtime._codec_accel", so)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

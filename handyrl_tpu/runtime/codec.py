@@ -11,10 +11,19 @@ object dtypes).  Model parameters travel as flax-msgpack byte blobs
 Format: one tag byte per value, big-endian fixed-width lengths.  Arrays
 are C-contiguous raw buffers, so encode/decode is O(bytes) memcpy — the
 host-side framing never touches the device path.
+
+Two interchangeable implementations share the format: this pure-Python
+module (the specification, and the fallback) and a C extension
+(`_codec_accel.c`, compiled on first import by `_codec_build.py`) that
+removes the per-small-object overhead dominating episode-block encoding
+on 1-core actor hosts.  ``dumps``/``loads`` dispatch to the accelerator
+when it loaded; ``HANDYRL_NO_CODEC_ACCEL=1`` forces pure Python.
+Cross-implementation byte-equality is pinned by tests/test_distributed.py.
 """
 
 from __future__ import annotations
 
+import os
 import struct
 from typing import Any
 
@@ -29,7 +38,17 @@ class CodecError(ValueError):
     pass
 
 
-def _encode(obj: Any, out: list) -> None:
+# shared with the C accelerator (MAX_DEPTH in _codec_accel.c): both
+# implementations must accept and reject the same nesting, or a frame
+# encoded on an accelerated host would fail to decode on a fallback host
+# (and deep nesting must surface as CodecError, not RecursionError, so
+# connection loops handle it)
+_MAX_DEPTH = 500
+
+
+def _encode(obj: Any, out: list, depth: int = 0) -> None:
+    if depth > _MAX_DEPTH:
+        raise CodecError("nesting too deep")
     if obj is None:
         out.append(b"N")
     elif obj is True:
@@ -71,28 +90,28 @@ def _encode(obj: Any, out: list) -> None:
         out.append(_U32.pack(len(raw)))
         out.append(raw)
     elif isinstance(obj, (np.bool_, np.integer, np.floating)):
-        _encode(obj.item(), out)
+        _encode(obj.item(), out, depth + 1)
     elif isinstance(obj, list):
         out.append(b"l")
         out.append(_U32.pack(len(obj)))
         for item in obj:
-            _encode(item, out)
+            _encode(item, out, depth + 1)
     elif isinstance(obj, tuple):
         out.append(b"t")
         out.append(_U32.pack(len(obj)))
         for item in obj:
-            _encode(item, out)
+            _encode(item, out, depth + 1)
     elif isinstance(obj, dict):
         out.append(b"d")
         out.append(_U32.pack(len(obj)))
         for key, value in obj.items():
-            _encode(key, out)
-            _encode(value, out)
+            _encode(key, out, depth + 1)
+            _encode(value, out, depth + 1)
     else:
         raise CodecError(f"type {type(obj).__name__} is not wire-encodable")
 
 
-def dumps(obj: Any) -> bytes:
+def py_dumps(obj: Any) -> bytes:
     out: list = []
     _encode(obj, out)
     return b"".join(out)
@@ -117,7 +136,9 @@ class _Reader:
         return _U32.unpack(self.take(4))[0]
 
 
-def _decode(r: _Reader) -> Any:
+def _decode(r: _Reader, depth: int = 0) -> Any:
+    if depth > _MAX_DEPTH:
+        raise CodecError("nesting too deep")
     tag = r.take(1)
     if tag == b"N":
         return None
@@ -139,15 +160,15 @@ def _decode(r: _Reader) -> Any:
         raw = r.take(r.u32())
         return np.frombuffer(raw, dtype=dt).reshape(shape).copy()
     if tag == b"l":
-        return [_decode(r) for _ in range(r.u32())]
+        return [_decode(r, depth + 1) for _ in range(r.u32())]
     if tag == b"t":
-        return tuple(_decode(r) for _ in range(r.u32()))
+        return tuple(_decode(r, depth + 1) for _ in range(r.u32()))
     if tag == b"d":
-        return {_decode(r): _decode(r) for _ in range(r.u32())}
+        return {_decode(r, depth + 1): _decode(r, depth + 1) for _ in range(r.u32())}
     raise CodecError(f"unknown tag {tag!r}")
 
 
-def loads(buf: bytes) -> Any:
+def py_loads(buf: bytes) -> Any:
     r = _Reader(bytes(buf))
     try:
         obj = _decode(r)
@@ -163,3 +184,28 @@ def loads(buf: bytes) -> Any:
     if r.pos != len(r.buf):
         raise CodecError("trailing bytes after message")
     return obj
+
+
+# -- accelerator dispatch ----------------------------------------------------
+
+def _accel_disabled() -> bool:
+    # conventional boolean parsing: "0"/"false"/empty mean the switch is
+    # OFF (accelerator stays on) — bare truthiness would read "=0" as
+    # disable, the opposite of what an operator means by it
+    return os.environ.get("HANDYRL_NO_CODEC_ACCEL", "").strip().lower() not in (
+        "", "0", "false", "no",
+    )
+
+
+_accel = None
+if not _accel_disabled():
+    try:
+        from . import _codec_build
+
+        _accel = _codec_build.load()
+        _accel.init(CodecError, np)
+    except Exception:  # no compiler / read-only fs / exotic platform
+        _accel = None
+
+dumps = _accel.dumps if _accel is not None else py_dumps
+loads = _accel.loads if _accel is not None else py_loads

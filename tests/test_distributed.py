@@ -8,6 +8,7 @@ network battle mode.
 """
 
 import socket
+import sys
 import threading
 
 import numpy as np
@@ -82,6 +83,94 @@ def test_codec_roundtrip_episode_like():
         "blocks": [b"compressed-block-1", b"compressed-block-2"],
     }
     assert codec.loads(codec.dumps(episode)) == episode
+
+
+def _codec_corpus():
+    return [
+        None, True, False, 0, -(2**40), 2**62, 3.5, float("inf"), "hello ∑",
+        b"\x00\xffbytes", bytearray(b"ba"), memoryview(b"mv"),
+        [1, [2, "x"], None], (1, 2.5, "t"),
+        {"a": 1, 0: "int-key", 1: {"nested": b"ok"}},
+        np.arange(12, dtype=np.int32).reshape(3, 4),
+        np.random.RandomState(3).randn(2, 3, 5).astype(np.float32),
+        np.array(True), np.zeros((0, 7), np.float64),
+        np.float32(2.5), np.int64(7), np.bool_(True),
+        {"blocks": [b"z" * 300] * 4, "outcome": {0: 1.0, 1: -1.0}},
+    ]
+
+
+def test_codec_accel_loads_on_linux():
+    """The C accelerator must actually build here — a silent fallback to
+    pure Python on a platform with a compiler would hide a regression."""
+    if sys.platform != "linux":
+        pytest.skip("accelerator is best-effort off Linux")
+    if codec._accel_disabled():
+        pytest.skip("HANDYRL_NO_CODEC_ACCEL disables the accelerator")
+    assert codec._accel is not None
+
+
+def test_codec_impls_byte_identical_and_interop():
+    """The C accelerator and the pure-Python codec must produce the SAME
+    bytes (the format has one spec) and decode each other's output."""
+    if codec._accel is None:
+        pytest.skip("accelerator unavailable")
+    for obj in _codec_corpus():
+        b_py = codec.py_dumps(obj)
+        b_c = codec._accel.dumps(obj)
+        assert b_py == b_c, f"byte mismatch for {obj!r}"
+        for decoded in (codec.py_loads(b_c), codec._accel.loads(b_py)):
+            if isinstance(obj, np.ndarray):
+                assert decoded.dtype == obj.dtype and decoded.shape == obj.shape
+                np.testing.assert_array_equal(decoded, obj)
+            elif isinstance(obj, (bytearray, memoryview)):
+                assert decoded == bytes(obj)
+            elif isinstance(obj, (np.bool_, np.integer, np.floating)):
+                assert decoded == obj.item()
+            else:
+                assert decoded == obj
+
+
+def test_codec_accel_malformed_frames():
+    """Every strict prefix of a valid frame, and hostile headers, must
+    surface as CodecError from BOTH implementations — connection receive
+    loops drop the peer on CodecError; anything else would kill them."""
+    impls = [codec.py_loads] + ([codec._accel.loads] if codec._accel else [])
+    frame = codec.py_dumps(
+        {"a": [1, 2.5, "s"], "arr": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    )
+    for loads in impls:
+        for i in range(len(frame)):
+            with pytest.raises(codec.CodecError):
+                loads(frame[:i])
+        with pytest.raises(codec.CodecError):
+            loads(frame + b"x")
+        # hostile array header: junk dtype
+        with pytest.raises(codec.CodecError):
+            loads(b"a\x00\x00\x00\x02zz\x00\x00\x00\x01\x00\x00\x00\x05"
+                  b"\x00\x00\x00\x04abcd")
+        # raw-size / shape mismatch -> reshape error -> CodecError
+        with pytest.raises(codec.CodecError):
+            loads(b"a\x00\x00\x00\x03<f4\x00\x00\x00\x01\x00\x00\x00\x05"
+                  b"\x00\x00\x00\x04abcd")
+        # unknown tag
+        with pytest.raises(codec.CodecError):
+            loads(b"Z")
+
+
+def test_codec_accel_depth_guard():
+    """A deeply nested frame must fail bounded (CodecError), not smash the
+    C stack: 'l' with count 1, nested a few thousand deep."""
+    deep = b"l\x00\x00\x00\x01" * 4000 + b"N"
+    impls = [codec.py_loads] + ([codec._accel.loads] if codec._accel else [])
+    for loads in impls:
+        with pytest.raises(codec.CodecError):
+            loads(deep)
+    if codec._accel is not None:
+        lst = None
+        for _ in range(4000):
+            lst = [lst]
+        with pytest.raises(codec.CodecError):
+            codec._accel.dumps(lst)
 
 
 def test_codec_rejects_unencodable():
