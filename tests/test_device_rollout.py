@@ -571,6 +571,61 @@ class TestVectorGeisterParity:
         m = jax.device_get(metrics)
         assert np.isfinite(m["total"]) and m["dcnt"] > 0
 
+    def test_streaming_transformer_kv_cache_hidden(self):
+        """The transformer family's KV-cache hidden must ride the SAME
+        streaming hidden-carry machinery as the DRC ConvLSTM: lanes carry
+        per-(lane, player) cache pytrees, episodes finish, and the
+        harvested windows train through the seq-attention path."""
+        from handyrl_tpu.envs.vector_geister import VectorGeister
+        from handyrl_tpu.parallel import TrainContext, make_mesh
+        from handyrl_tpu.runtime.batch import make_batch
+        from handyrl_tpu.runtime.device_rollout import StreamingDeviceRollout
+
+        env = make_env({
+            "env": "Geister", "net": "transformer",
+            "net_args": {"d_model": 32, "n_heads": 2, "n_layers": 2,
+                         "memory_len": 8},
+        })
+        module = env.net()
+        variables = init_variables(module, env)
+        cfg = normalize_args({
+            "env_args": {"env": "Geister"},
+            "train_args": {"batch_size": 8, "forward_steps": 6,
+                           "burn_in_steps": 2, "observation": True,
+                           "seq_attention": "einsum"},
+        })
+        args = dict(cfg["train_args"])
+        args["env"] = cfg["env_args"]
+        roll = StreamingDeviceRollout(
+            VectorGeister, module, args, n_lanes=8, k_steps=64
+        )
+        key = jax.random.PRNGKey(0)
+        episodes = []
+        for _ in range(4):
+            key, sub = jax.random.split(key)
+            episodes += roll.generate(variables["params"], sub)
+        assert episodes, "no episode finished with the transformer policy"
+        ep = episodes[0]
+        cols = [decompress_block(b) for b in ep["blocks"]]
+        tmask = np.concatenate([c["tmask"] for c in cols])
+        assert (tmask.sum(axis=1) == 1.0).all()  # strict alternation held
+
+        store = EpisodeStore(64)
+        store.extend(episodes)
+        windows = []
+        while len(windows) < args["batch_size"]:
+            w = store.sample_window(
+                args["forward_steps"], args["burn_in_steps"], args["compress_steps"]
+            )
+            if w is not None:
+                windows.append(w)
+        batch = make_batch(windows, args)
+        ctx = TrainContext(module, args, make_mesh({"dp": -1}))
+        tstate = ctx.init_state(variables["params"])
+        tstate, metrics = ctx.train_step(tstate, ctx.put_batch(batch), 1e-4)
+        m = jax.device_get(metrics)
+        assert np.isfinite(m["total"]) and m["dcnt"] > 0
+
     def test_observation_false_records_actors_only(self):
         """With ``observation: false`` the device path must record turn
         players only (omask == tmask), matching host-generator episodes in
