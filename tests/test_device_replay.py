@@ -214,6 +214,53 @@ def test_geister_turn_train_fn_runs(geister_rollout_data):
     ) > 0, "params did not move"
 
 
+def test_transformer_turn_mode_trains_from_rings():
+    """The transformer family (KV-cache hidden, seq-attention training)
+    through turn-mode device replay: streamed Geister records ingest into
+    rings, windows assemble on device, and the seq-path train step
+    consumes them — finite loss, real data count.  Completes the
+    model-family x data-path matrix (DRC was the only turn-mode net)."""
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.envs.vector_geister import VectorGeister
+    from handyrl_tpu.models import init_variables
+    from handyrl_tpu.runtime.device_rollout import build_streaming_fn
+
+    env = make_env({
+        "env": "Geister", "net": "transformer",
+        "net_args": {"d_model": 32, "n_heads": 2, "n_layers": 2,
+                     "memory_len": 8},
+    })
+    module = env.net()
+    params = init_variables(module, env)["params"]
+    cfg = normalize_args({
+        "env_args": {"env": "Geister"},
+        "train_args": {"turn_based_training": True, "observation": True,
+                       "batch_size": 4, "forward_steps": 4,
+                       "burn_in_steps": 2, "seq_attention": "einsum",
+                       "mesh": {"dp": 1}},
+    })
+    args = dict(cfg["train_args"])
+    args["env"] = cfg["env_args"]
+    mesh = make_mesh({"dp": 1})
+    lanes = 4
+    fn = build_streaming_fn(VectorGeister, module, lanes, 64, mesh=None,
+                            use_observe_mask=True)
+    replay = DeviceReplay(VectorGeister, module, args, mesh, lanes, slots=64)
+    state = VectorGeister.init(lanes, jax.random.PRNGKey(3))
+    hidden = module.initial_state((lanes, VectorGeister.num_players))
+    key = jax.random.PRNGKey(4)
+    for _ in range(5):
+        key, sub = jax.random.split(key)
+        state, hidden, records = fn(params, state, hidden, sub)
+        replay.ingest(records)
+    assert replay.eligible_count() > 0
+    ctx = TrainContext(module, args, mesh)
+    train = replay.train_fn(ctx, fused_steps=1)
+    tstate, metrics = train(ctx.init_state(params), jax.random.PRNGKey(5), 1e-4)
+    m = jax.device_get(metrics)
+    assert np.isfinite(m["total"]) and m["dcnt"] > 0
+
+
 def test_eligibility_and_wrap(rollout_data):
     """After the ring wraps, every eligible slot belongs to a finished,
     still-resident episode — and partially-overwritten episodes only offer
