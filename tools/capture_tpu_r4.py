@@ -8,7 +8,10 @@ chip answers this script banks everything in one clean process:
   2. duty-cycle sweep (``tools/tune_northstar.py`` in-process) — the
      lanes x k_steps x fused x trains_per_rollout knee (VERDICT item 3);
   3. bf16 vs fp32 device-math profile (``tools/profile_bf16.py``
-     in-process) with jax.profiler traces (VERDICT item 8).
+     in-process) with jax.profiler traces (VERDICT item 8);
+  4. flash-vs-einsum on the pinned transformer shape
+     (``tools/tune_transformer.py`` d1024 variants — the open
+     attn-mode question, docs/ROUND4.md).
 
 Run on the tunneled TPU (NO platform override), in the background, and
 let it EXIT CLEANLY — SIGKILL/SIGTERM on a process that initialized the
@@ -129,6 +132,21 @@ def main() -> None:
         profile_bf16.main()
     except Exception:
         traceback.print_exc()
+
+    # -- stage 4: attn-mode comparison on the pinned transformer shape ---
+    # TPU-only even under a CPU override: the d1024 shapes run the Pallas
+    # kernel through the INTERPRETER on CPU — hours, not a smoke test
+    if got_tpu:
+        print(f"[{time.time()-t0:.0f}s] stage 4: transformer attn-mode", flush=True)
+        try:
+            import tune_transformer
+
+            os.environ["TUNE_ONLY"] = "d1024_B64_T64_bf16,d1024_B64_T64_einsum"
+            if quick:
+                os.environ.setdefault("TUNE_T", "4")
+            tune_transformer.main()
+        except Exception:
+            traceback.print_exc()
 
     print(f"[{time.time()-t0:.0f}s] capture complete", flush=True)
 
