@@ -122,6 +122,16 @@ class DeviceEvaluator:
 
     def __init__(self, venv, module, n_lanes: int,
                  opponent: str = "rulebase", k_steps: int = 32, mesh=None):
+        # fail at construction, not at the first evaluate() trace: the
+        # eval stream drives the STREAMING contract; episodic twins
+        # (VectorTicTacToe-style) don't have it
+        if not (hasattr(venv, "reset_done") and hasattr(venv, "step")):
+            raise ValueError(
+                f"DeviceEvaluator needs a streaming vector env "
+                f"(reset_done/step hooks); "
+                f"{getattr(venv, '__name__', type(venv).__name__)} is "
+                "episodic — use host eval workers for this env"
+            )
         self.venv = venv
         self.module = module
         self.n_lanes = n_lanes

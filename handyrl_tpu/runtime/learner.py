@@ -194,18 +194,9 @@ class Learner:
                     f"for this vector env; evaluating vs 'random' instead"
                 )
                 opp = "random"
-            # fail at startup, not at the first epoch boundary inside the
-            # eval thread: device eval drives the STREAMING contract
-            # (reset_done/step/legal_mask_all); episodic twins
-            # (VectorTicTacToe-style, e.g. the Connect Four example) don't
-            # have it
-            if not (hasattr(venv, "reset_done") and hasattr(venv, "step")):
-                raise ValueError(
-                    f"device_eval_games needs a streaming vector env "
-                    f"(reset_done/step hooks); "
-                    f"{getattr(venv, '__name__', type(venv).__name__)} is "
-                    "episodic — use host eval workers for this env"
-                )
+            # DeviceEvaluator rejects episodic twins (no streaming
+            # reset_done/step hooks) at construction — surfacing the
+            # device_eval_games misconfiguration at learner startup
             from .device_eval import DeviceEvaluator
 
             mesh = self.trainer.ctx.mesh
