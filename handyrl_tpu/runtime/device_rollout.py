@@ -374,6 +374,22 @@ def make_device_rollout(venv, module, args: Dict[str, Any], n_games: int, mesh=N
     calls (VectorTicTacToe's 9-ply games)."""
     if hasattr(venv, "record"):
         return StreamingDeviceRollout(venv, module, args, n_lanes=n_games, mesh=mesh)
+    if module.initial_state((1,)) is not None:
+        # build_selfplay_fn steps with hidden=None (fresh state every ply):
+        # a stateful policy self-plays MEMORYLESSLY on this driver.  The
+        # recorded behavior probs are still the true behavior policy, so
+        # training stays sound (off-policy corrections), but the data is
+        # not what host actors (which carry hidden) would generate — say so
+        import sys
+
+        print(
+            "[handyrl_tpu] episodic device rollout steps a stateful model "
+            "(RNN/KV-cache) with a fresh hidden state every ply — self-play "
+            "is memoryless on this driver; for memory-faithful device "
+            "self-play give the env a streaming vector twin (record/"
+            "reset_done/step hooks), or use host actors",
+            file=sys.stderr,
+        )
     return DeviceRollout(venv, module, args, n_games)
 
 
