@@ -173,6 +173,34 @@ def test_codec_accel_depth_guard():
             codec._accel.dumps(lst)
 
 
+def test_codec_fallback_forced(tmp_path):
+    """HANDYRL_NO_CODEC_ACCEL=1 must leave the pure-Python codec fully
+    functional (the accelerator is strictly optional) — checked in a
+    subprocess because the dispatch is bound at import time."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    script = (
+        "from handyrl_tpu.runtime import codec\n"
+        "assert codec._accel is None, 'accelerator loaded despite disable'\n"
+        "assert codec.dumps is codec.py_dumps\n"
+        "b = codec.dumps({'x': [1, 2.5, 'y']})\n"
+        "assert codec.loads(b) == {'x': [1, 2.5, 'y']}\n"
+        "print('fallback-ok')\n"
+    )
+    out = subprocess.run(
+        [_sys.executable, "-c", script],
+        env={**os.environ, "HANDYRL_NO_CODEC_ACCEL": "1",
+             "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr.decode(errors="replace")
+    assert b"fallback-ok" in out.stdout
+
+
 def test_codec_rejects_unencodable():
     with pytest.raises(codec.CodecError):
         codec.dumps(object())
