@@ -347,7 +347,11 @@ def test_learner_device_replay_end_to_end(tmp_path, monkeypatch):
     learner.run()
 
     records = [json.loads(l) for l in open("metrics.jsonl")]
-    assert len(records) == 2
+    # `epochs` counts MODEL UPDATES; a metrics record is written at every
+    # epoch boundary, including pre-warmup ones where the trainer had
+    # nothing yet — on a loaded host that adds an extra leading record
+    # (reproduced 2026-08-01 under a concurrent suite run)
+    assert 2 <= len(records) <= 3
     assert records[-1]["steps"] > 0, "no SGD updates ran"
     assert records[-1]["episodes"] >= 80, "episode counters did not reach epoch 2"
     # generation stats came from device counters (host saw no episodes)
@@ -397,7 +401,9 @@ def test_learner_geister_device_replay_end_to_end(tmp_path, monkeypatch):
     learner.run()
 
     records = [json.loads(l) for l in open("metrics.jsonl")]
-    assert len(records) == 1
+    # epochs count model updates; pre-warmup boundaries may add a leading
+    # record on a loaded host (see the geese test above)
+    assert 1 <= len(records) <= 2
     assert records[-1]["steps"] > 0, "no SGD updates ran"
     assert os.path.exists("models/latest.ckpt")
     assert learner.trainer.store.total_added == 0, (
