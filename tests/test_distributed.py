@@ -173,6 +173,65 @@ def test_codec_accel_depth_guard():
             codec._accel.dumps(lst)
 
 
+def test_codec_impls_agree_on_random_structures():
+    """Seeded structural fuzz: both implementations must byte-agree and
+    round-trip on arbitrary nested payloads, not just the fixed corpus."""
+    if codec._accel is None:
+        pytest.skip("accelerator unavailable")
+    rng = np.random.RandomState(1234)
+
+    def gen(depth):
+        kinds = ["int", "float", "str", "bytes", "none", "bool", "arr"]
+        if depth < 3:
+            kinds += ["list", "tuple", "dict"] * 2
+        k = kinds[rng.randint(len(kinds))]
+        if k == "int":
+            return int(rng.randint(-(2**62), 2**62))
+        if k == "float":
+            return float(rng.randn() * 10 ** rng.randint(-8, 8))
+        if k == "str":
+            return "".join(chr(rng.randint(32, 0x2FF)) for _ in range(rng.randint(0, 12)))
+        if k == "bytes":
+            return bytes(rng.bytes(rng.randint(0, 32)))
+        if k == "none":
+            return None
+        if k == "bool":
+            return bool(rng.randint(2))
+        if k == "arr":
+            dt = [np.float32, np.float64, np.int32, np.int8, np.bool_][rng.randint(5)]
+            shape = tuple(rng.randint(0, 4) for _ in range(rng.randint(0, 3)))
+            # outer asarray AFTER the arithmetic: numpy returns a SCALAR
+            # from 0-d math, and np scalars decay to python scalars on the
+            # wire by design — this branch must produce a true ndarray
+            # (including the 0-d case, the historical codec edge)
+            return np.asarray(rng.randn(*shape) * 100).astype(dt)
+        n = rng.randint(0, 5)
+        if k == "list":
+            return [gen(depth + 1) for _ in range(n)]
+        if k == "tuple":
+            return tuple(gen(depth + 1) for _ in range(n))
+        return {f"k{i}": gen(depth + 1) for i in range(n)}
+
+    def eq(a, b):
+        if isinstance(a, np.ndarray):
+            return (isinstance(b, np.ndarray) and a.dtype == b.dtype
+                    and a.shape == b.shape and np.array_equal(a, b))
+        if isinstance(a, (list, tuple)):
+            return (type(a) is type(b) and len(a) == len(b)
+                    and all(eq(x, y) for x, y in zip(a, b)))
+        if isinstance(a, dict):
+            return (isinstance(b, dict) and a.keys() == b.keys()
+                    and all(eq(a[k], b[k]) for k in a))
+        return a == b and type(a) is type(b)
+
+    for _ in range(200):
+        obj = gen(0)
+        b_py = codec.py_dumps(obj)
+        assert b_py == codec._accel.dumps(obj), repr(obj)
+        assert eq(codec._accel.loads(b_py), codec.py_loads(b_py)), repr(obj)
+        assert eq(codec.py_loads(b_py), obj), repr(obj)
+
+
 def test_codec_fallback_forced(tmp_path):
     """HANDYRL_NO_CODEC_ACCEL=1 must leave the pure-Python codec fully
     functional (the accelerator is strictly optional) — checked in a
