@@ -168,6 +168,22 @@ def test_stage_filter_skips_unlisted_stages(monkeypatch, _fast_sleep):
     assert result["error"] is None
 
 
+def test_known_stages_matches_run_stage_call_sites():
+    """KNOWN_STAGES is the BENCH_STAGES validation whitelist; a stage
+    added to main() without updating it would be impossible to select
+    (the filter would reject its name as unknown).  Parse the source for
+    _run_stage call sites and pin exact agreement."""
+    import re
+    from pathlib import Path
+
+    src = Path(bench.__file__).read_text()
+    called = set(re.findall(r'_run_stage\(result, "([^"]+)"', src))
+    assert called == set(bench.KNOWN_STAGES), (
+        f"KNOWN_STAGES drift: called-but-unknown {called - set(bench.KNOWN_STAGES)}, "
+        f"known-but-never-called {set(bench.KNOWN_STAGES) - called}"
+    )
+
+
 def test_sig_preserves_small_rates():
     assert bench._sig(0.0021234) == 0.00212
     assert bench._sig(None) is None
