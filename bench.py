@@ -21,12 +21,19 @@ Every timed window stretches until at least one unit (update / episode)
 completes — a slow backend yields a small measured rate or an explicit
 null+note, never a silent 0.0.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"} plus
-"extra" with the geese numbers.  Never exits non-zero for backend trouble:
-a wedged chip lease is waited out (re-probe loop, BENCH_TPU_WAIT budget,
-default 30 min) before the CPU fallback, each stage retries once on a
-transient failure, and unrecoverable failures still print the JSON with
-an "error" field.
+Prints json lines of the shape {"metric", "value", "unit", "vs_baseline"}
+plus "extra": one snapshot after the probe and after every stage (marked
+"partial") and a final unmarked line, each also atomically replacing the
+side file ``bench_snapshot.json`` — so a SIGKILL at any moment leaves the
+newest parseable state on stdout's last line AND on disk.  Never exits
+non-zero for backend trouble: a wedged chip lease is waited out (re-probe
+loop, BENCH_TPU_WAIT budget) but only up to the outer deadline
+(BENCH_DEADLINE_S, default 1700 s) minus a reserve for the headline stage
+(BENCH_RESERVE_S, default 300 s) — a 29-minute wedge can no longer eat
+the measuring window (the r04 rc=124 failure).  Each stage retries once
+on a transient failure; stages that would start with < BENCH_STAGE_MIN_S
+of deadline left are skipped with an honest note so the run finishes
+clean (rc=0) before the driver's kill.
 """
 
 from __future__ import annotations
@@ -86,6 +93,72 @@ def _tpu_wait_budget() -> float:
     return _env_float("BENCH_TPU_WAIT", 1800.0)
 
 
+def _deadline_s() -> float:
+    """Outer wall-clock deadline for the WHOLE run (BENCH_DEADLINE_S,
+    default 1700 s, 0 disables).  The driver kills the bench at roughly
+    1,800 s; r04 spent 1,741 s of that waiting out a wedged lease and was
+    killed ~60 s into the first stage having printed nothing parseable.
+    Everything that can spend time — the lease wait, stage starts, the
+    measuring watchdog — budgets against this deadline so the process
+    always finishes (or snapshots) BEFORE the driver's kill."""
+    return _env_float("BENCH_DEADLINE_S", 1700.0)
+
+
+def _effective_tpu_wait() -> float:
+    """Lease-wait budget capped against the outer deadline: the wait may
+    never eat the measuring window.  BENCH_RESERVE_S (default 300 s) is
+    held back for the headline TicTacToe stage — the r04 lesson: a
+    29-minute wedge left ~1 minute to measure, which is none."""
+    wait = _tpu_wait_budget()
+    deadline = _deadline_s()
+    if deadline <= 0:
+        return wait
+    reserve = _env_float("BENCH_RESERVE_S", 300.0)
+    return min(wait, max(0.0, deadline - (time.perf_counter() - _T0) - reserve))
+
+
+def _snapshot_path() -> str:
+    return os.environ.get("BENCH_SNAPSHOT") or "bench_snapshot.json"
+
+
+def _emit_snapshot(result: dict, final: bool = False) -> None:
+    """Write the accumulated result as a complete JSON line to stdout AND
+    atomically replace the side file — after the probe and after every
+    stage — so a SIGKILL at ANY moment leaves the newest parseable
+    snapshot behind (r04 printed exactly once, at the very end, and was
+    killed first).  Every line is the full result-so-far; a consumer
+    taking the last parseable stdout line always gets the newest state.
+    Non-final lines carry a "partial" marker naming where the run was.
+    Serialized under a lock: the watchdog thread emits concurrently with
+    the main thread, and two writers on one tmp path could install a
+    truncated side file (or interleave the stdout lines)."""
+    with _EMIT_LOCK:
+        snap = dict(result)
+        snap["extra"] = dict(result.get("extra") or {})
+        if final:
+            snap.pop("partial", None)
+        else:
+            snap["partial"] = {
+                "at": _LAST_NOTE,
+                "elapsed_s": round(time.perf_counter() - _T0),
+            }
+        line = json.dumps(snap, default=str)
+        print(line, flush=True)
+        try:  # side file is best-effort; stdout is the contract
+            path = _snapshot_path()
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(line + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+
+_EMIT_LOCK = threading.Lock()
+
+
 def _start_watchdog(result: dict, done: "threading.Event",
                     budget: Optional[float] = None) -> None:
     """A single wedged device dispatch must not cost the whole capture: a
@@ -119,7 +192,7 @@ def _start_watchdog(result: dict, done: "threading.Event",
                 snap = dict(result)
                 snap["extra"] = dict(result.get("extra") or {})
                 snap["error"] = (snap.get("error") or "") + msg
-                print(json.dumps(snap, default=str))
+                _emit_snapshot(snap, final=True)
             except Exception:  # racing mutation: still honor the JSON contract
                 print(json.dumps({"metric": result.get("metric"), "value": None,
                                   "unit": "env-steps/s", "vs_baseline": None,
@@ -172,7 +245,10 @@ def _devices_with_retry(retries: int = 3, delay: float = 20.0):
         apply_platform_override()
         return jax.devices(), None
 
-    wait_budget = _tpu_wait_budget()
+    # the wait budget is capped against the outer deadline (minus the
+    # headline-stage reserve): a 29-minute wedge must never eat the
+    # measuring window (the r04 rc=124 failure)
+    wait_budget = _effective_tpu_wait()
     reprobe_wait = min(150.0, max(wait_budget, 1.0))
 
     err = None
@@ -981,12 +1057,25 @@ def _run_stage(result: dict, name: str, fn, retries: int = 1,
     if only is not None and name not in only:
         result["extra"].setdefault("stages_skipped", []).append(name)
         return None
+    deadline = _deadline_s()
+    if deadline > 0:
+        remaining = deadline - (time.perf_counter() - _T0)
+        if remaining < _env_float("BENCH_STAGE_MIN_S", 60.0):
+            # too little runway for a meaningful measurement: finish clean
+            # (rc=0, honest note) instead of being SIGKILLed mid-stage
+            result["extra"].setdefault("stages_deadline_skipped", []).append(name)
+            _note(f"{name}: skipped — {remaining:.0f}s of {deadline:.0f}s "
+                  f"deadline left")
+            _emit_snapshot(result)
+            return None
     errs = []
     for attempt in range(retries + 1):
         snap = {k: result[k] for k in ("value", "vs_baseline", "error")}
         snap_extra = dict(result["extra"])
         try:
-            return fn()
+            val = fn()
+            _emit_snapshot(result)
+            return val
         except Exception:
             result.update(snap)
             result["extra"] = snap_extra
@@ -997,6 +1086,7 @@ def _run_stage(result: dict, name: str, fn, retries: int = 1,
                       f"{retry_delay:.0f}s")
                 time.sleep(retry_delay)
     result["error"] = (result["error"] or "") + f" {name}: " + " | ".join(errs)
+    _emit_snapshot(result)
     return None
 
 
@@ -1019,27 +1109,44 @@ def main() -> None:
             f"unknown BENCH_STAGES name(s) {sorted(only - set(KNOWN_STAGES))}; "
             f"valid: {', '.join(KNOWN_STAGES)}"
         )
-        print(json.dumps(result))
+        _emit_snapshot(result, final=True)
         return
 
     done = threading.Event()
 
     # probe-phase watchdog: bounds the lease-wait loop AND the in-process
-    # jax.devices() init (which can hang just like the subprocess probe)
+    # jax.devices() init (which can hang just like the subprocess probe).
+    # Budget: the deadline-capped lease wait plus slack for one held probe
+    # and the in-process init — sized to fire BEFORE the driver's kill
+    # (the r04 watchdog armed at wait+900 = 900 s past the kill).
     probe_done = threading.Event()
-    _start_watchdog(result, probe_done, budget=_tpu_wait_budget() + 900.0)
+    probe_budget = _effective_tpu_wait() + 240.0
+    if _deadline_s() > 0:
+        probe_budget = min(probe_budget, max(60.0, _deadline_s() - 30.0))
+    _start_watchdog(result, probe_done, budget=probe_budget)
     devices, backend_err = _devices_with_retry()
     probe_done.set()
     if backend_err:
         result["error"] = str(backend_err)
     if devices is None:
-        print(json.dumps(result))
+        _emit_snapshot(result, final=True)
         return
     result["platform"] = f"{devices[0].platform}:{getattr(devices[0], 'device_kind', '?')} x{len(devices)}"
+    # first parseable line lands the moment the probe resolves: even a
+    # kill during the headline stage leaves platform + any probe error
+    _emit_snapshot(result)
 
     # the measuring watchdog clock starts AFTER the probe: waiting out a
-    # wedged lease (up to BENCH_TPU_WAIT) must not eat the measuring budget
-    _start_watchdog(result, done)
+    # wedged lease must not eat the measuring budget.  Under a deadline it
+    # fires ~30 s before the driver's kill so a wedged dispatch still ends
+    # in a clean final JSON + rc=0 instead of SIGKILL.
+    wd_budget = _env_float("BENCH_WATCHDOG_S", 2700.0)
+    if wd_budget > 0 and _deadline_s() > 0:
+        wd_budget = min(
+            wd_budget,
+            max(60.0, _deadline_s() - (time.perf_counter() - _T0) - 30.0),
+        )
+    _start_watchdog(result, done, budget=wd_budget)
 
     peak = _peak_flops(devices[0])
     n_dev = len(devices)
@@ -1365,7 +1472,7 @@ def main() -> None:
         _run_stage(result, "flash", stage_flash)  # kernel path is TPU-only
 
     done.set()
-    print(json.dumps(result))
+    _emit_snapshot(result, final=True)
 
 
 if __name__ == "__main__":

@@ -26,6 +26,13 @@ def _fast_sleep(monkeypatch):
     yield sleeps
 
 
+@pytest.fixture(autouse=True)
+def _snapshot_tmp(monkeypatch, tmp_path):
+    """Stage runs now emit snapshot side files; keep them out of the repo."""
+    monkeypatch.setenv("BENCH_SNAPSHOT", str(tmp_path / "snap.json"))
+    yield tmp_path / "snap.json"
+
+
 def test_env_float_parses_and_falls_back(monkeypatch):
     monkeypatch.delenv("X_BENCH_T", raising=False)
     assert bench._env_float("X_BENCH_T", 7.5) == 7.5
@@ -189,3 +196,122 @@ def test_sig_preserves_small_rates():
     assert bench._sig(None) is None
     assert bench._sig(0) == 0
     assert bench._sig(123456.0) == 123456.0  # never truncates above the decimal
+
+
+# ---- round-5 deadline-proofing: incremental snapshots + outer deadline ----
+
+
+def _fresh_result():
+    return {"metric": "m", "value": None, "unit": "u", "vs_baseline": None,
+            "platform": None, "error": None, "extra": {}}
+
+
+def test_emit_snapshot_stdout_and_side_file(capsys, _snapshot_tmp):
+    """Every emission is a complete parseable JSON line on stdout AND an
+    atomically-replaced side file; partial lines carry the marker, the
+    final line does not (r04 printed once at the end and was killed
+    first — nothing parseable survived)."""
+    import json
+
+    result = _fresh_result()
+    result["value"] = 1.0
+    bench._emit_snapshot(result)
+    result["value"] = 2.0
+    bench._emit_snapshot(result, final=True)
+
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 2
+    first, last = json.loads(lines[0]), json.loads(lines[-1])
+    assert first["value"] == 1.0 and "partial" in first
+    assert first["partial"]["at"]  # names where the run was
+    assert last["value"] == 2.0 and "partial" not in last
+    # side file holds the newest state, no tmp litter left behind
+    on_disk = json.loads(_snapshot_tmp.read_text())
+    assert on_disk["value"] == 2.0
+    assert not list(_snapshot_tmp.parent.glob("*.tmp.*"))
+
+
+def test_run_stage_emits_snapshot_after_success_and_failure(capsys):
+    """A kill at ANY moment between stages leaves the newest accumulated
+    state as the last parseable stdout line."""
+    import json
+
+    result = _fresh_result()
+
+    def ok():
+        result["value"] = 42.0
+        return "ok"
+
+    assert bench._run_stage(result, "s1", ok) == "ok"
+
+    def bad():
+        raise RuntimeError("boom")
+
+    bench._run_stage(result, "s2", bad, retry_delay=0.0)
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) >= 2
+    last = json.loads(lines[-1])
+    assert last["value"] == 42.0          # s1's number survived s2's failure
+    assert "s2" in (last["error"] or "")  # s2's failure is in the snapshot
+
+
+def test_effective_tpu_wait_capped_by_deadline(monkeypatch):
+    """The lease wait may never eat the measuring window: with 1700 s of
+    deadline and a 300 s headline reserve, a 1800 s BENCH_TPU_WAIT is
+    capped to what actually fits (the r04 rc=124 failure: the wait spent
+    1741 s of the driver's ~1800 s budget)."""
+    monkeypatch.setenv("BENCH_TPU_WAIT", "1800")
+    monkeypatch.setenv("BENCH_DEADLINE_S", "1700")
+    monkeypatch.setenv("BENCH_RESERVE_S", "300")
+    monkeypatch.setattr(bench, "_T0", 0.0)
+    t = [100.0]  # 100 s already elapsed (imports, setup)
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: t[0])
+    assert bench._effective_tpu_wait() == pytest.approx(1300.0)
+    # deadline disabled -> raw BENCH_TPU_WAIT
+    monkeypatch.setenv("BENCH_DEADLINE_S", "0")
+    assert bench._effective_tpu_wait() == 1800.0
+    # deadline nearly spent -> no negative budgets
+    monkeypatch.setenv("BENCH_DEADLINE_S", "1700")
+    t[0] = 1650.0
+    assert bench._effective_tpu_wait() == 0.0
+
+
+def test_lease_wait_respects_deadline(monkeypatch):
+    """End-to-end through _devices_with_retry: with the deadline close,
+    a wedged lease is surrendered early enough to leave the reserve."""
+    monkeypatch.delenv("HANDYRL_PLATFORM", raising=False)
+    monkeypatch.setenv("BENCH_TPU_WAIT", "1800")
+    monkeypatch.setenv("BENCH_DEADLINE_S", "700")
+    monkeypatch.setenv("BENCH_RESERVE_S", "300")
+    monkeypatch.setattr(bench, "_T0", 0.0)
+    t = [0.0]
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: t[0])
+    monkeypatch.setattr(bench.time, "sleep", lambda s: t.__setitem__(0, t[0] + s))
+    probes = []
+    monkeypatch.setattr(
+        bench, "_probe_accelerator",
+        lambda timeout=120.0: probes.append(1) or ("hung", "hung >120s"),
+    )
+    devices, err = bench._devices_with_retry()
+    assert err and "CPU fallback" in err
+    # budget was 700-300=400 s -> at most ~3 re-probe sleeps of 150 s,
+    # nowhere near the 1800 s raw wait
+    assert t[0] <= 400.0
+
+
+def test_run_stage_deadline_skip(monkeypatch, capsys):
+    """Stages that would start with too little runway are skipped with an
+    honest note (clean rc=0 finish beats a SIGKILL mid-stage)."""
+    import json
+
+    monkeypatch.setenv("BENCH_DEADLINE_S", "1000")
+    monkeypatch.setattr(bench, "_T0", 0.0)
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: 970.0)
+    result = _fresh_result()
+    ran = []
+    assert bench._run_stage(result, "late", lambda: ran.append(1)) is None
+    assert ran == []
+    assert result["extra"]["stages_deadline_skipped"] == ["late"]
+    assert result["error"] is None
+    last = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert last["extra"]["stages_deadline_skipped"] == ["late"]
