@@ -121,7 +121,8 @@ def _snapshot_path() -> str:
     return os.environ.get("BENCH_SNAPSHOT") or "bench_snapshot.json"
 
 
-def _emit_snapshot(result: dict, final: bool = False) -> None:
+def _emit_snapshot(result: dict, final: bool = False,
+                   lock_timeout: Optional[float] = None) -> None:
     """Write the accumulated result as a complete JSON line to stdout AND
     atomically replace the side file — after the probe and after every
     stage — so a SIGKILL at ANY moment leaves the newest parseable
@@ -131,8 +132,16 @@ def _emit_snapshot(result: dict, final: bool = False) -> None:
     Non-final lines carry a "partial" marker naming where the run was.
     Serialized under a lock: the watchdog thread emits concurrently with
     the main thread, and two writers on one tmp path could install a
-    truncated side file (or interleave the stdout lines)."""
-    with _EMIT_LOCK:
+    truncated side file (or interleave the stdout lines).  The watchdog
+    passes ``lock_timeout`` so a main thread wedged INSIDE the lock (a
+    stuck fsync) cannot block the emergency emission forever — after the
+    timeout it emits anyway (interleaving risk only in that already-
+    pathological case) and proceeds to os._exit."""
+    if lock_timeout is None:
+        got = _EMIT_LOCK.acquire()
+    else:
+        got = _EMIT_LOCK.acquire(timeout=lock_timeout)
+    try:
         snap = dict(result)
         snap["extra"] = dict(result.get("extra") or {})
         if final:
@@ -154,6 +163,9 @@ def _emit_snapshot(result: dict, final: bool = False) -> None:
             os.replace(tmp, path)
         except OSError:
             pass
+    finally:
+        if got:
+            _EMIT_LOCK.release()
 
 
 _EMIT_LOCK = threading.Lock()
@@ -192,7 +204,7 @@ def _start_watchdog(result: dict, done: "threading.Event",
                 snap = dict(result)
                 snap["extra"] = dict(result.get("extra") or {})
                 snap["error"] = (snap.get("error") or "") + msg
-                _emit_snapshot(snap, final=True)
+                _emit_snapshot(snap, final=True, lock_timeout=10.0)
             except Exception:  # racing mutation: still honor the JSON contract
                 print(json.dumps({"metric": result.get("metric"), "value": None,
                                   "unit": "env-steps/s", "vs_baseline": None,
@@ -1116,13 +1128,19 @@ def main() -> None:
 
     # probe-phase watchdog: bounds the lease-wait loop AND the in-process
     # jax.devices() init (which can hang just like the subprocess probe).
-    # Budget: the deadline-capped lease wait plus slack for one held probe
-    # and the in-process init — sized to fire BEFORE the driver's kill
-    # (the r04 watchdog armed at wait+900 = 900 s past the kill).
+    # Under a deadline the budget is simply the REMAINING time minus 30 s
+    # — it must fire before the driver's kill (the r04 watchdog armed at
+    # wait+900 = 900 s past the kill), and remaining-30 also upper-bounds
+    # the wait loop's own worst case (wait is capped at remaining minus a
+    # 300 s reserve, so a healthy run that resolves at wait+~240 still
+    # clears the watchdog with slack).  No deadline: the old wait+900.
     probe_done = threading.Event()
-    probe_budget = _effective_tpu_wait() + 240.0
     if _deadline_s() > 0:
-        probe_budget = min(probe_budget, max(60.0, _deadline_s() - 30.0))
+        probe_budget = max(
+            60.0, _deadline_s() - (time.perf_counter() - _T0) - 30.0
+        )
+    else:
+        probe_budget = _effective_tpu_wait() + 900.0
     _start_watchdog(result, probe_done, budget=probe_budget)
     devices, backend_err = _devices_with_retry()
     probe_done.set()

@@ -292,7 +292,11 @@ class TrainContext:
                 "recurrent/memory models (RNN hidden or KV-cache transformer) "
                 "under turn-based training require train_args.observation: "
                 "true — per-step observations for every player are needed to "
-                "build their all-player training windows"
+                "build their all-player training windows.  (For a "
+                "SINGLE-player custom env the turn player is the target "
+                "player every step, so the carry is well-defined either "
+                "way — set observation: true, or turn_based_training: "
+                "false, to proceed.)"
             )
         self.mesh = mesh
         self.tx = make_optimizer()

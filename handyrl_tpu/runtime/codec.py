@@ -46,6 +46,17 @@ class CodecError(ValueError):
 _MAX_DEPTH = 500
 
 
+def _pack_u32(n: int) -> bytes:
+    """Length header pack that fails the same way the C accelerator does:
+    a >= 2**32 str/bytes/array/container length must raise CodecError on
+    BOTH implementations (the accelerator's enc_len_u32 does; bare
+    _U32.pack would let struct.error escape from the fallback host)."""
+    try:
+        return _U32.pack(n)
+    except struct.error as exc:
+        raise CodecError(f"length out of u32 range: {n}") from exc
+
+
 def _encode(obj: Any, out: list, depth: int = 0) -> None:
     if depth > _MAX_DEPTH:
         raise CodecError("nesting too deep")
@@ -67,12 +78,12 @@ def _encode(obj: Any, out: list, depth: int = 0) -> None:
     elif isinstance(obj, str):
         raw = obj.encode("utf-8")
         out.append(b"s")
-        out.append(_U32.pack(len(raw)))
+        out.append(_pack_u32(len(raw)))
         out.append(raw)
     elif isinstance(obj, (bytes, bytearray, memoryview)):
         raw = bytes(obj)
         out.append(b"b")
-        out.append(_U32.pack(len(raw)))
+        out.append(_pack_u32(len(raw)))
         out.append(raw)
     elif isinstance(obj, np.ndarray):
         if obj.dtype.hasobject:
@@ -81,29 +92,29 @@ def _encode(obj: Any, out: list, depth: int = 0) -> None:
         arr = np.ascontiguousarray(obj)
         dt = arr.dtype.str.encode("ascii")
         out.append(b"a")
-        out.append(_U32.pack(len(dt)))
+        out.append(_pack_u32(len(dt)))
         out.append(dt)
-        out.append(_U32.pack(len(shape)))
+        out.append(_pack_u32(len(shape)))
         for d in shape:
-            out.append(_U32.pack(d))
+            out.append(_pack_u32(d))
         raw = arr.tobytes()
-        out.append(_U32.pack(len(raw)))
+        out.append(_pack_u32(len(raw)))
         out.append(raw)
     elif isinstance(obj, (np.bool_, np.integer, np.floating)):
         _encode(obj.item(), out, depth + 1)
     elif isinstance(obj, list):
         out.append(b"l")
-        out.append(_U32.pack(len(obj)))
+        out.append(_pack_u32(len(obj)))
         for item in obj:
             _encode(item, out, depth + 1)
     elif isinstance(obj, tuple):
         out.append(b"t")
-        out.append(_U32.pack(len(obj)))
+        out.append(_pack_u32(len(obj)))
         for item in obj:
             _encode(item, out, depth + 1)
     elif isinstance(obj, dict):
         out.append(b"d")
-        out.append(_U32.pack(len(obj)))
+        out.append(_pack_u32(len(obj)))
         for key, value in obj.items():
             _encode(key, out, depth + 1)
             _encode(value, out, depth + 1)

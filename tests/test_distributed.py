@@ -173,6 +173,19 @@ def test_codec_accel_depth_guard():
             codec._accel.dumps(lst)
 
 
+def test_codec_oversized_length_is_codec_error():
+    """Exception-type parity on >= 2**32 lengths: the C accelerator raises
+    CodecError via enc_len_u32; the pure-Python fallback must match — an
+    accelerated host and a fallback host have to fail the same way on the
+    same oversized frame.  (Allocating a real 4 GiB payload is off the
+    table on the 1-core host, so the length pack is exercised directly.)"""
+    with pytest.raises(codec.CodecError):
+        codec._pack_u32(2**32)
+    with pytest.raises(codec.CodecError):
+        codec._pack_u32(-1)
+    assert codec._pack_u32(2**32 - 1) == b"\xff\xff\xff\xff"
+
+
 def test_codec_impls_agree_on_random_structures():
     """Seeded structural fuzz: both implementations must byte-agree and
     round-trip on arbitrary nested payloads, not just the fixed corpus."""

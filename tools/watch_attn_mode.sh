@@ -9,6 +9,11 @@ cd "$(dirname "$0")/.." || exit 1
 PIDFILE=/tmp/attn_mode_watch.pid
 [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null && { echo "watcher already running"; exit 0; }
 echo $$ > "$PIDFILE"
+# clean up on ANY exit (incl. kill): a stale pidfile whose PID gets
+# recycled would make the liveness check refuse to start a new watcher.
+# Only if it is still OURS — an old instance exiting must not delete a
+# live successor's pidfile.
+trap '[ "$(cat "$PIDFILE" 2>/dev/null)" = "$$" ] && rm -f "$PIDFILE"' EXIT
 while true; do
   if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[watch $(date -u +%H:%M:%S)] chip answered; running attn-mode comparison"
