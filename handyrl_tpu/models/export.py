@@ -84,6 +84,22 @@ class _ArtifactModel(SingleInferenceMixin):
             return flat
         return tree_map(lambda x: np.broadcast_to(x, tuple(batch_dims) + x.shape).copy(), flat)
 
+    def _extract_hidden(self, out: Dict[str, Any]) -> Dict[str, Any]:
+        """Fold flat next-step-state outputs back into an ``out['hidden']``
+        pytree.  Names: 'hidden_N_out' (torch-bridge ONNX artifacts; ONNX
+        graph values are SSA, so outputs cannot reuse the input names) or
+        bare 'hidden_N' (the TF-bridge artifacts)."""
+        hid_names = sorted(
+            (k for k in out if k.startswith("hidden_")),
+            key=lambda k: int(k[7:-4] if k.endswith("_out") else k[7:]),
+        )
+        if hid_names:
+            _, hid_tree = jax.tree.flatten(self._hidden0)
+            out["hidden"] = jax.tree.unflatten(
+                hid_tree, [out.pop(k) for k in hid_names]
+            )
+        return out
+
 
 class ExportedModel(_ArtifactModel):
     """Inference over a serialized artifact; same API as InferenceModel.
@@ -195,46 +211,43 @@ def export_savedmodel(module, variables, sample_obs, path: str) -> None:
 
 
 def export_onnx(module, variables, sample_obs, path: str) -> None:
-    """Freeze (module, variables) into a real ``.onnx`` file via
-    jax2tf -> tf2onnx — the reference's exact artifact kind
-    (scripts/make_onnx_model.py:28-58), produced from the same traced
-    function as ``export_savedmodel`` (identical input/output naming,
-    dynamic batch axis).  Requires the optional ``tf2onnx`` dependency;
-    raises ImportError with guidance when it is missing.  A sidecar
-    ``<path>.meta`` carries the pytree structure + initial hidden so
-    ``OnnxModel`` can rebuild framework-shaped inputs/outputs."""
-    try:
-        import tf2onnx
-    except ImportError as exc:  # pragma: no cover - optional dep
-        raise ImportError(
-            "ONNX export needs the optional 'tf2onnx' package "
-            "(pip install tf2onnx); alternatively export a '.tf' "
-            "SavedModel and convert offline with `python -m tf2onnx.convert "
-            "--saved-model <dir> --output model.onnx`"
-        ) from exc
-    import tensorflow as tf
-    from jax.experimental import jax2tf
+    """Freeze (module, variables) into a real ``.onnx`` file — the
+    reference's exact artifact kind (scripts/make_onnx_model.py:28-58) —
+    via the jaxpr->torch bridge (``torch_export.py``): the inference
+    jaxpr is interpreted with torch ops and serialized by torch's C++
+    TorchScript ONNX exporter (no ``onnx``/``tf2onnx`` needed; numerics
+    are verified against jax at two batch sizes before the file is
+    written).  The earlier jax2tf->tf2onnx route is dead on modern JAX:
+    jax2tf always emits ``XlaCallModule`` (``native_serialization=False``
+    is deprecated and ignored), which no ONNX converter accepts.
 
+    Same naming contract as ``export_savedmodel``: inputs ``input_N`` /
+    ``hidden_N``, outputs keep their dict keys, next-step state as
+    ``hidden_N_out``, batch axis dynamic.  A sidecar ``<path>.meta``
+    carries the pytree structure + initial hidden so ``OnnxModel`` can
+    rebuild framework-shaped inputs/outputs."""
     from ..runtime import codec
+    from .torch_export import export_onnx_via_torch
 
-    fn, leaves, names, hidden0, n_obs = _bridge_fn(module, variables, sample_obs)
-    converted = jax2tf.convert(
-        fn,
-        polymorphic_shapes=[_poly(l) for l in leaves],
-        with_gradient=False,
-        # tf2onnx consumes a plain TF graph; XLA custom-call ops
-        # (stablehlo wrappers) are not representable in ONNX
-        native_serialization=False,
-    )
-    f = tf.function(
-        converted,
-        input_signature=[_tf_spec(l, n) for l, n in zip(leaves, names)],
-        autograph=False,
-    )
-    tf2onnx.convert.from_function(
-        f,
-        input_signature=[_tf_spec(l, n) for l, n in zip(leaves, names)],
-        output_path=path,
+    fn, leaves, in_names, hidden0, n_obs = _bridge_fn(module, variables, sample_obs)
+    probe = fn(*leaves)
+    out_keys = sorted(probe.keys())  # jax dict pytrees flatten key-sorted
+    out_names = [
+        k + "_out" if k.startswith("hidden_") else k for k in out_keys
+    ]
+
+    def tup_fn(*ls):
+        d = fn(*ls)
+        return tuple(d[k] for k in out_keys)
+
+    # trace at batch 5 (not 1): a batch-1 jaxpr cannot distinguish
+    # "broadcast into batch" from "keep batch-1", which bakes the batch
+    # into the graph; an unusual trace batch also lets the bridge
+    # recognize the batch extent structurally (torch_export.py)
+    tiled = tuple(np.repeat(np.asarray(l), 5, axis=0) for l in leaves)
+    export_onnx_via_torch(
+        tup_fn, tiled, path,
+        input_names=list(in_names), output_names=out_names,
     )
     meta = {
         "n_obs": n_obs,
@@ -286,13 +299,7 @@ class OnnxModel(_ArtifactModel):
         out_names = [o.name for o in self._sess.get_outputs()]
         vals = self._sess.run(out_names, feeds)
         out = dict(zip(out_names, (np.asarray(v) for v in vals)))
-        hid_names = sorted(
-            (k for k in out if k.startswith("hidden_")), key=lambda k: int(k[7:])
-        )
-        if hid_names:
-            _, hid_tree = jax.tree.flatten(self._hidden0)
-            out["hidden"] = jax.tree.unflatten(hid_tree, [out.pop(k) for k in hid_names])
-        return out
+        return self._extract_hidden(out)
 
 
 class SavedModelModel(_ArtifactModel):
@@ -326,10 +333,4 @@ class SavedModelModel(_ArtifactModel):
         hid_leaves = jax.tree.leaves(tree_map(np.asarray, hidden)) if hidden is not None else []
         out = self._loaded.f(*[self._tf.constant(l) for l in obs_leaves + hid_leaves])
         out = {k: np.asarray(v) for k, v in out.items()}
-        hid_names = sorted(
-            (k for k in out if k.startswith("hidden_")), key=lambda k: int(k[7:])
-        )
-        if hid_names:
-            _, hid_tree = jax.tree.flatten(self._hidden0)
-            out["hidden"] = jax.tree.unflatten(hid_tree, [out.pop(k) for k in hid_names])
-        return out
+        return self._extract_hidden(out)

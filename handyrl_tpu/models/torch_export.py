@@ -1,0 +1,554 @@
+"""Real ONNX export without tf2onnx: jaxpr -> torch -> ONNX ModelProto.
+
+Round-5 finding (caught by the conversion-contract test this replaces):
+modern jax2tf ALWAYS emits ``XlaCallModule`` — ``native_serialization=
+False`` is deprecated and ignored (jax 0.9: the parameter is ``del``eted
+on entry) — so the jax2tf -> tf2onnx pipeline the round-3 exporter
+promised cannot produce a convertible graph on current JAX anywhere,
+including the CI extras job.  The replacement here goes through torch,
+whose TorchScript ONNX exporter serializes the ModelProto in C++ (no
+``onnx`` package needed):
+
+    jax.make_jaxpr(inference fn)  ->  TorchJaxpr (an nn.Module that
+    interprets the jaxpr with torch ops; params ride as buffers)
+    ->  torch.jit.trace  ->  torch.onnx.export
+
+The interpreter covers the closed primitive set of this framework's
+inference nets (SimpleConvNet, GeeseNet, DRC ConvLSTM, KV-cache
+transformer — 35 primitives, enumerated by tracing each family) and
+fails loudly on anything outside it.  Correctness is pinned in-image,
+without any ONNX runtime: TorchJaxpr output == jax output (elementwise)
+at the traced batch AND at a different batch through the traced graph —
+the exact graph the ONNX serializer sees — so the artifact's math and
+its dynamic batch axis are both verified before the file is written.
+
+Artifact contract (reference parity, scripts/make_onnx_model.py:28-58):
+observation pytree leaves -> ``input_N`` inputs, hidden-state leaves ->
+``hidden_N``, outputs keep their dict keys (+ ``hidden_N_out`` for the
+next-step state), batch axis dynamic, opset 17.  The ``<path>.meta``
+sidecar (wire codec) carries the pytree structure + initial hidden for
+``OnnxModel`` (export.py) to rebuild framework-shaped values.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["TorchJaxpr", "export_onnx_via_torch"]
+
+
+_TORCH_DTYPES = {
+    "float32": "float32", "float16": "float16", "bfloat16": "bfloat16",
+    "float64": "float64", "int32": "int32", "int64": "int64",
+    "int16": "int16", "int8": "int8", "uint8": "uint8", "bool": "bool",
+}
+
+
+def _to_torch_dtype(torch, np_dtype) -> Any:
+    name = np.dtype(np_dtype).name if np_dtype != bool else "bool"
+    if name not in _TORCH_DTYPES:
+        raise NotImplementedError(f"dtype {name} not mapped to torch")
+    return getattr(torch, _TORCH_DTYPES[name])
+
+
+def _einsum_letters(n: int) -> List[str]:
+    import string
+
+    return list(string.ascii_lowercase[:n])
+
+
+class _Interpreter:
+    """Evaluate a jaxpr with torch tensors.  Every handler uses only
+    torch ops the TorchScript ONNX exporter lowers to standard ONNX
+    (Conv, MatMul/Einsum, elementwise, Reduce*, Where, Concat, ...)."""
+
+    def __init__(self, torch, batch_dynamic: bool):
+        self.torch = torch
+        self.batch_dynamic = batch_dynamic
+        self.trace_batch: Optional[int] = None  # set by TorchJaxpr.forward
+        self._batch_col = None  # (B, 1) zeros, dynamic under trace
+
+    def begin(self, args) -> None:
+        """Stash a dynamic (B, 1) zero column from the first input —
+        built with shape-free ops (flatten/slice) so torch.jit.trace
+        keeps the batch extent symbolic.  Broadcasts INTO the batch use
+        it: ``x + zeros(B, 1...)`` dynamically batches a size-1 tensor,
+        where a static ``expand`` would bake the traced batch."""
+        if not self.batch_dynamic or not args:
+            self._batch_col = None
+            return
+        a = args[0]
+        if a.dim() == 1:
+            a = a.unsqueeze(1)
+        self._batch_col = a.flatten(1)[:, :1].float() * 0.0
+
+    def _dynamic_batchify(self, x):
+        """(1, d1, ...) -> (B, d1, ...) with B symbolic under trace."""
+        t = self.torch
+        z = self._batch_col.reshape([-1] + [1] * (x.dim() - 1))
+        if x.dtype == t.bool:
+            return (x.to(t.uint8) + z.to(t.uint8)).to(t.bool)
+        return x + z.to(x.dtype)
+
+    # -- driver ----------------------------------------------------------
+    def run(self, jaxpr, consts: Sequence, args: Sequence) -> List:
+        env: Dict[Any, Any] = {}
+
+        def read(v):
+            from jax.extend.core import Literal
+
+            if isinstance(v, Literal):
+                t = self.torch.as_tensor(np.asarray(v.val))
+                return t
+            return env[v]
+
+        for var, const in zip(jaxpr.constvars, consts):
+            env[var] = const
+        for var, arg in zip(jaxpr.invars, args):
+            env[var] = arg
+
+        for eqn in jaxpr.eqns:
+            fn = getattr(self, "p_" + eqn.primitive.name.replace("-", "_"), None)
+            if fn is None:
+                raise NotImplementedError(
+                    f"jax primitive '{eqn.primitive.name}' is outside the "
+                    "ONNX-exportable inference set (torch_export.py); "
+                    "extend _Interpreter to cover it"
+                )
+            invals = [read(v) for v in eqn.invars]
+            out = fn(eqn, invals)
+            if eqn.primitive.multiple_results:
+                for var, val in zip(eqn.outvars, out):
+                    env[var] = val
+            else:
+                env[eqn.outvars[0]] = out
+        return [read(v) for v in jaxpr.outvars]
+
+    def _inline(self, eqn, invals, key):
+        inner = eqn.params[key]
+        # ClosedJaxpr: consts are embedded values
+        consts = [self.torch.as_tensor(np.asarray(c)) for c in inner.consts]
+        return self.run(inner.jaxpr, consts, invals)
+
+    # -- call-like primitives (inlined) ---------------------------------
+    def p_pjit(self, eqn, invals):
+        return self._inline(eqn, invals, "jaxpr")
+
+    p_jit = p_pjit
+
+    def p_custom_jvp_call(self, eqn, invals):
+        return self._inline(eqn, invals, "call_jaxpr")
+
+    def p_custom_vjp_call(self, eqn, invals):
+        return self._inline(eqn, invals, "call_jaxpr")
+
+    def p_closed_call(self, eqn, invals):
+        return self._inline(eqn, invals, "call_jaxpr")
+
+    # -- elementwise -----------------------------------------------------
+    def p_add(self, eqn, iv):
+        return iv[0] + iv[1]
+
+    def p_sub(self, eqn, iv):
+        return iv[0] - iv[1]
+
+    def p_mul(self, eqn, iv):
+        return iv[0] * iv[1]
+
+    def p_div(self, eqn, iv):
+        a, b = iv
+        if not a.dtype.is_floating_point and not b.dtype.is_floating_point:
+            # lax.div on integers truncates toward zero
+            return self.torch.div(a, b, rounding_mode="trunc")
+        return a / b
+
+    def p_rem(self, eqn, iv):
+        return self.torch.fmod(iv[0], iv[1])  # lax.rem: sign of dividend
+
+    def p_max(self, eqn, iv):
+        return self.torch.maximum(iv[0], iv[1])
+
+    def p_min(self, eqn, iv):
+        return self.torch.minimum(iv[0], iv[1])
+
+    def p_and(self, eqn, iv):
+        return self.torch.logical_and(iv[0], iv[1])
+
+    def p_or(self, eqn, iv):
+        return self.torch.logical_or(iv[0], iv[1])
+
+    def p_eq(self, eqn, iv):
+        return iv[0] == iv[1]
+
+    def p_ne(self, eqn, iv):
+        return iv[0] != iv[1]
+
+    def p_ge(self, eqn, iv):
+        return iv[0] >= iv[1]
+
+    def p_gt(self, eqn, iv):
+        return iv[0] > iv[1]
+
+    def p_le(self, eqn, iv):
+        return iv[0] <= iv[1]
+
+    def p_lt(self, eqn, iv):
+        return iv[0] < iv[1]
+
+    def p_neg(self, eqn, iv):
+        return -iv[0]
+
+    def p_exp(self, eqn, iv):
+        return self.torch.exp(iv[0])
+
+    def p_log(self, eqn, iv):
+        return self.torch.log(iv[0])
+
+    def p_tanh(self, eqn, iv):
+        return self.torch.tanh(iv[0])
+
+    def p_logistic(self, eqn, iv):
+        return self.torch.sigmoid(iv[0])
+
+    def p_rsqrt(self, eqn, iv):
+        return self.torch.rsqrt(iv[0])
+
+    def p_sqrt(self, eqn, iv):
+        return self.torch.sqrt(iv[0])
+
+    def p_square(self, eqn, iv):
+        return iv[0] * iv[0]
+
+    def p_abs(self, eqn, iv):
+        return self.torch.abs(iv[0])
+
+    def p_sign(self, eqn, iv):
+        return self.torch.sign(iv[0])
+
+    def p_floor(self, eqn, iv):
+        return self.torch.floor(iv[0])
+
+    def p_stop_gradient(self, eqn, iv):
+        return iv[0]
+
+    def p_convert_element_type(self, eqn, iv):
+        return iv[0].to(_to_torch_dtype(self.torch, eqn.params["new_dtype"]))
+
+    def p_integer_pow(self, eqn, iv):
+        return iv[0] ** eqn.params["y"]
+
+    # -- shape ops -------------------------------------------------------
+    def p_reshape(self, eqn, iv):
+        assert eqn.params.get("dimensions") is None, "reshape with dimensions"
+        new_sizes = list(eqn.params["new_sizes"])
+        x = iv[0]
+        if (
+            self.batch_dynamic
+            and len(new_sizes) >= 1
+            and x.dim() >= 1
+            and new_sizes
+            and eqn.invars[0].aval.shape[:1] == tuple(new_sizes[:1])
+        ):
+            # leading dim preserved -> -1 keeps it symbolic under
+            # torch.jit.trace (x.shape[0] would be constant-folded), so a
+            # trace at batch B stays valid at any batch (the ONNX
+            # dynamic axis)
+            return x.reshape([-1] + [int(s) for s in new_sizes[1:]])
+        return x.reshape([int(s) for s in new_sizes])
+
+    def p_transpose(self, eqn, iv):
+        return iv[0].permute(*eqn.params["permutation"])
+
+    def p_squeeze(self, eqn, iv):
+        x = iv[0]
+        for d in sorted(eqn.params["dimensions"], reverse=True):
+            x = x.squeeze(d)
+        return x
+
+    def p_expand_dims(self, eqn, iv):
+        x = iv[0]
+        for d in sorted(eqn.params["dimensions"]):
+            x = x.unsqueeze(d)
+        return x
+
+    def p_broadcast_in_dim(self, eqn, iv):
+        x = iv[0]
+        shape = [int(s) for s in eqn.params["shape"]]
+        bdims = list(eqn.params["broadcast_dimensions"])  # strictly increasing
+        in_shape = eqn.invars[0].aval.shape  # static shapes from the jaxpr
+        # insert singleton dims at the unmapped output positions; existing
+        # dims keep their (possibly symbolic under trace) extents
+        for d in range(len(shape)):
+            if d not in bdims:
+                x = x.unsqueeze(d)
+        # expand: -1 (keep, stays symbolic) for carried dims, the static
+        # target for inserted dims and true size-1 broadcasts
+        expand = []
+        into_batch = False
+        for d in range(len(shape)):
+            if d in bdims:
+                i = bdims.index(d)
+                carried = not (in_shape[i] == 1 and shape[d] != 1)
+                expand.append(-1 if carried else shape[d])
+            else:
+                expand.append(shape[d])
+            if (
+                d == 0
+                and self.batch_dynamic
+                and self._batch_col is not None
+                and expand[0] == shape[0]          # static (not carried)
+                and shape[0] == self.trace_batch   # and it IS the batch
+            ):
+                # broadcast INTO the batch dim: expand to 1 here, then
+                # batch it dynamically so the trace stays batch-agnostic
+                expand[0] = -1 if d in bdims else 1
+                into_batch = True
+        out = x.expand(expand)
+        return self._dynamic_batchify(out) if into_batch else out
+
+    def p_slice(self, eqn, iv):
+        starts = eqn.params["start_indices"]
+        limits = eqn.params["limit_indices"]
+        strides = eqn.params["strides"] or (1,) * len(starts)
+        idx = tuple(
+            slice(int(s), int(l), int(st))
+            for s, l, st in zip(starts, limits, strides)
+        )
+        return iv[0][idx]
+
+    def p_split(self, eqn, iv):
+        sizes = [int(s) for s in eqn.params["sizes"]]
+        return list(self.torch.split(iv[0], sizes, dim=eqn.params["axis"]))
+
+    def p_concatenate(self, eqn, iv):
+        return self.torch.cat(list(iv), dim=eqn.params["dimension"])
+
+    def p_pad(self, eqn, iv):
+        x, pad_val = iv
+        cfg = eqn.params["padding_config"]
+        assert all(i == 0 for _, _, i in cfg), "interior padding unsupported"
+        # torch.nn.functional.pad lists dims LAST-first
+        flat: List[int] = []
+        for lo, hi, _ in reversed(cfg):
+            flat += [int(lo), int(hi)]
+        import torch.nn.functional as F
+
+        return F.pad(x, flat, value=float(pad_val))
+
+    def p_rev(self, eqn, iv):
+        return self.torch.flip(iv[0], dims=list(eqn.params["dimensions"]))
+
+    def p_iota(self, eqn, iv):
+        shape = [int(s) for s in eqn.params["shape"]]
+        dim = eqn.params["dimension"]
+        dtype = _to_torch_dtype(self.torch, eqn.params["dtype"])
+        r = self.torch.arange(shape[dim], dtype=dtype)
+        view = [1] * len(shape)
+        view[dim] = shape[dim]
+        return r.reshape(view).expand(shape)
+
+    def p_select_n(self, eqn, iv):
+        pred, *cases = iv
+        if len(cases) == 2:
+            return self.torch.where(pred.bool(), cases[1], cases[0])
+        out = cases[0]
+        for k in range(1, len(cases)):
+            out = self.torch.where(pred == k, cases[k], out)
+        return out
+
+    # -- reductions ------------------------------------------------------
+    def _axes(self, eqn):
+        return [int(a) for a in eqn.params["axes"]]
+
+    def p_reduce_sum(self, eqn, iv):
+        return iv[0].sum(dim=self._axes(eqn))
+
+    def p_reduce_max(self, eqn, iv):
+        return iv[0].amax(dim=self._axes(eqn))
+
+    def p_reduce_min(self, eqn, iv):
+        return iv[0].amin(dim=self._axes(eqn))
+
+    def p_reduce_and(self, eqn, iv):
+        x = iv[0]
+        for d in sorted(self._axes(eqn), reverse=True):
+            x = x.all(dim=d)
+        return x
+
+    def p_reduce_or(self, eqn, iv):
+        x = iv[0]
+        for d in sorted(self._axes(eqn), reverse=True):
+            x = x.any(dim=d)
+        return x
+
+    # -- contractions ----------------------------------------------------
+    def p_dot_general(self, eqn, iv):
+        lhs, rhs = iv
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        ln, rn = lhs.dim(), rhs.dim()
+        letters = iter("abcdefghijklmnopqrstuvwxyz")
+        l_spec = [""] * ln
+        r_spec = [""] * rn
+        out_batch, out_lfree, out_rfree = [], [], []
+        for i, j in zip(lb, rb):
+            c = next(letters)
+            l_spec[i] = c
+            r_spec[j] = c
+            out_batch.append(c)
+        for i, j in zip(lc, rc):
+            c = next(letters)
+            l_spec[i] = c
+            r_spec[j] = c
+        for i in range(ln):
+            if not l_spec[i]:
+                c = next(letters)
+                l_spec[i] = c
+                out_lfree.append(c)
+        for j in range(rn):
+            if not r_spec[j]:
+                c = next(letters)
+                r_spec[j] = c
+                out_rfree.append(c)
+        spec = (
+            "".join(l_spec) + "," + "".join(r_spec) + "->"
+            + "".join(out_batch + out_lfree + out_rfree)
+        )
+        return self.torch.einsum(spec, lhs, rhs)
+
+    def p_conv_general_dilated(self, eqn, iv):
+        import torch.nn.functional as F
+
+        lhs, rhs = iv
+        p = eqn.params
+        dn = p["dimension_numbers"]
+        lhs_spec, rhs_spec, out_spec = dn.lhs_spec, dn.rhs_spec, dn.out_spec
+        nd = len(lhs_spec) - 2
+        if any(d != 1 for d in p["lhs_dilation"]):
+            raise NotImplementedError("transposed conv (lhs_dilation) unsupported")
+        if p.get("batch_group_count", 1) != 1:
+            raise NotImplementedError("batch_group_count != 1 unsupported")
+        # to N C spatial... (torch layout), spatial order per the spec
+        x = lhs.permute([lhs_spec[0], lhs_spec[1]] + list(lhs_spec[2:]))
+        w = rhs.permute([rhs_spec[0], rhs_spec[1]] + list(rhs_spec[2:]))
+        pads = [(int(lo), int(hi)) for lo, hi in p["padding"]]
+        sym = all(lo == hi for lo, hi in pads)
+        if sym:
+            padding = [lo for lo, _ in pads]
+        else:
+            flat: List[int] = []
+            for lo, hi in reversed(pads):
+                flat += [lo, hi]
+            x = F.pad(x, flat)
+            padding = [0] * nd
+        conv = {1: F.conv1d, 2: F.conv2d, 3: F.conv3d}[nd]
+        y = conv(
+            x, w, stride=[int(s) for s in p["window_strides"]],
+            padding=padding, dilation=[int(d) for d in p["rhs_dilation"]],
+            groups=int(p["feature_group_count"]),
+        )
+        # y is N C' spatial' -> permute into out_spec order
+        inv = [0] * len(out_spec)
+        src = [out_spec[0], out_spec[1]] + list(out_spec[2:])
+        for pos, dim in enumerate(src):
+            inv[dim] = pos
+        return y.permute(inv)
+
+
+class TorchJaxpr:
+    """Builds an ``nn.Module`` whose forward interprets ``fn``'s jaxpr
+    with torch ops (constants/params ride as buffers)."""
+
+    def __new__(cls, fn, example_args, batch_dynamic: bool = True):
+        import torch
+
+        closed = __import__("jax").make_jaxpr(fn)(*example_args)
+        interp = _Interpreter(torch, batch_dynamic)
+        leaves = __import__("jax").tree.leaves(example_args)
+        interp.trace_batch = (
+            int(leaves[0].shape[0]) if leaves and np.ndim(leaves[0]) else None
+        )
+
+        class _Mod(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self._consts = []
+                for i, c in enumerate(closed.consts):
+                    t = torch.as_tensor(np.asarray(c))
+                    self.register_buffer(f"const_{i}", t)
+                    self._consts.append(t)
+
+            def forward(self, *flat_inputs):
+                consts = [getattr(self, f"const_{i}")
+                          for i in range(len(self._consts))]
+                interp.begin(list(flat_inputs))
+                outs = interp.run(closed.jaxpr, consts, list(flat_inputs))
+                return tuple(outs)
+
+        mod = _Mod().eval()
+        mod.closed_jaxpr = closed
+        return mod
+
+
+def export_onnx_via_torch(fn, example_args, path: str,
+                          input_names: List[str],
+                          output_names: List[str]) -> None:
+    """Trace ``fn``'s jaxpr-interpreting torch module and write a real
+    ONNX ModelProto via torch's C++ serializer.  Verifies numerics at
+    the example batch AND at a different batch through the traced graph
+    before writing; works without the ``onnx`` package (the exporter's
+    only use of it — appending registered onnxscript functions — is
+    bypassed as a no-op when none can exist)."""
+    import torch
+
+    import jax
+
+    mod = TorchJaxpr(fn, example_args)
+    flat_np = [np.asarray(x) for x in jax.tree.leaves(example_args)]
+    tin = [torch.as_tensor(x) for x in flat_np]
+
+    # numeric pin 1: eager interpreter vs jax at the traced batch
+    want = [np.asarray(x) for x in jax.tree.leaves(fn(*example_args))]
+    got = [t.detach().numpy() for t in mod(*tin)]
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+    traced = torch.jit.trace(mod, tuple(tin))
+
+    # numeric pin 2: the TRACED graph (what ONNX serializes) at batch 3
+    B = flat_np[0].shape[0]
+    if all(x.ndim >= 1 and x.shape[0] == B for x in flat_np):
+        rng = np.random.default_rng(0)
+        flat3 = [
+            rng.standard_normal((3,) + x.shape[1:]).astype(x.dtype)
+            if np.issubdtype(x.dtype, np.floating)
+            else np.repeat(x[:1], 3, axis=0)
+            for x in flat_np
+        ]
+        args3 = jax.tree.unflatten(jax.tree.structure(example_args), flat3)
+        want3 = [np.asarray(x) for x in jax.tree.leaves(fn(*args3))]
+        got3 = [t.detach().numpy()
+                for t in traced(*[torch.as_tensor(x) for x in flat3])]
+        for w, g in zip(want3, got3):
+            np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+    try:
+        import onnx  # noqa: F401  -- present in the CI extras env
+    except ImportError:
+        from torch.onnx._internal.torchscript_exporter import onnx_proto_utils
+
+        # no onnxscript functions can be registered without the package;
+        # the step is a structural no-op, so skipping it is lossless
+        onnx_proto_utils._add_onnxscript_fn = (
+            lambda model_bytes, custom_opsets: model_bytes
+        )
+
+    torch.onnx.export(
+        traced, tuple(tin), path,
+        input_names=input_names,
+        output_names=output_names,
+        dynamic_axes={n: {0: "batch"} for n in input_names + output_names},
+        opset_version=17,
+        dynamo=False,
+    )

@@ -580,14 +580,38 @@ PEAK_FLOPS_BY_KIND = [
 ]
 
 
+def _by_device_kind(table, device) -> Optional[float]:
+    """First-match substring lookup over a (tag, value) table; tag order
+    matters (longer tags like 'v5p' before 'v5')."""
+    kind = getattr(device, "device_kind", "").lower()
+    for tag, value in table:
+        if tag in kind:
+            return value
+    return None
+
+
 def peak_flops_per_chip(device) -> Optional[float]:
     """Peak dense FLOP/s for ``device`` (None when the kind is unknown —
     callers report MFU as null-with-reason rather than guessing)."""
-    kind = getattr(device, "device_kind", "").lower()
-    for tag, peak in PEAK_FLOPS_BY_KIND:
-        if tag in kind:
-            return peak
-    return None
+    return _by_device_kind(PEAK_FLOPS_BY_KIND, device)
+
+
+# peak HBM bandwidth per chip, bytes/s (public figures) — the other
+# roofline axis: a step whose arithmetic intensity (flops / bytes
+# accessed) sits below the ridge point peak_flops/bw is bandwidth-bound
+# and its MFU ceiling is intensity * bw / peak_flops (tools/roofline.py)
+HBM_BW_BY_KIND = [
+    ("v6", 1640e9),
+    ("v5p", 2765e9),
+    ("v5", 819e9),    # v5e / v5 litepod
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+]
+
+
+def hbm_bandwidth_per_chip(device) -> Optional[float]:
+    return _by_device_kind(HBM_BW_BY_KIND, device)
 
 
 def jaxpr_flops(jaxpr) -> float:

@@ -11,9 +11,11 @@ Usage:
 
 ``out_path`` ending in ``.tf`` writes a TF SavedModel via jax2tf instead
 — the bridge for non-JAX runtimes (TF Serving / TFLite).  ``out_path``
-ending in ``.onnx`` produces the reference's exact artifact kind via
-jax2tf -> tf2onnx; this needs the optional ``tf2onnx`` package and fails
-with guidance when it is missing.
+ending in ``.onnx`` produces the reference's exact artifact kind via the
+jaxpr -> torch bridge (``models/torch_export.py``): torch's C++ ONNX
+serializer, numerics verified against jax at two batch sizes before the
+file is written; no optional packages needed to EXPORT (onnxruntime is
+only needed to load it back).
 
 Reads env from ./config.yaml (like the reference reads config.yaml for
 the env to export).

@@ -1,0 +1,127 @@
+"""Roofline analysis of the game-net train steps (VERDICT r4 #5).
+
+The bench's honest game-net MFUs are small (r4 chip capture: tictactoe
+0.0154, geese 0.0356, northstar2 0.0194) and BASELINE.md asserts
+"model-size artifact, not framework overhead".  This tool PROVES or
+REFUTES that from the compiled programs themselves: for each stage's
+exact train step it pulls XLA cost analysis (flops + bytes accessed),
+computes arithmetic intensity AI = flops/bytes, and compares against the
+chip's ridge point peak_flops/hbm_bw (v5e: 197e12/819e9 = 240
+flops/byte).  A step with AI far below the ridge is bandwidth-bound and
+its MFU CEILING is AI * bw / peak — if the measured MFU sits near that
+ceiling, the small number is physics, not overhead; if far below, the
+framework is leaving throughput on the table.
+
+Run on the chip for the real fusion/layout numbers
+(`python tools/roofline.py`); CPU fallback (`HANDYRL_PLATFORM=cpu`)
+records its platform and is an approximation only (XLA:CPU fuses
+differently).  Writes docs/captures/roofline_<stamp>.json and prints a
+human summary; docs/performance.md carries the conclusions.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _cost(ctx, state, device_batch):
+    """(flops, bytes_accessed) from the bound executable's cost analysis."""
+    lowered = ctx._bind(state).lower(
+        state, device_batch, __import__("jax").numpy.float32(1e-5)
+    )
+    ca = lowered.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return float(ca.get("flops", 0.0)), float(ca.get("bytes accessed", 0.0))
+
+
+def stage(env_name: str, overrides: dict, measured_mfu_key: str):
+    import jax
+
+    import bench
+    from handyrl_tpu.parallel import TrainContext, make_mesh
+    from handyrl_tpu.parallel.train_step import (
+        hbm_bandwidth_per_chip, peak_flops_per_chip,
+    )
+
+    args = bench._make_args(env_name, overrides)
+    n_dev = len(jax.devices())
+    if args["batch_size"] % n_dev:
+        args["batch_size"] = max(n_dev, args["batch_size"] // n_dev * n_dev)
+    _, module, model, store = bench._fill_store(args, 16)
+    mesh = make_mesh(args["mesh"])
+    ctx = TrainContext(module, args, mesh)
+    state = ctx.init_state(model.variables["params"])
+    db = ctx.put_batch(bench._sample_batch(store, args))
+    flops, nbytes = _cost(ctx, state, db)
+
+    dev = jax.devices()[0]
+    peak = peak_flops_per_chip(dev)
+    bw = hbm_bandwidth_per_chip(dev)
+    out = {
+        "env": env_name,
+        "batch_size": args["batch_size"],
+        "forward_steps": args["forward_steps"],
+        "flops_per_step": flops,
+        "bytes_accessed_per_step": nbytes,
+        "arithmetic_intensity": round(flops / nbytes, 3) if nbytes else None,
+        "measured_mfu_key": measured_mfu_key,
+    }
+    if peak and bw and nbytes:
+        ridge = peak / bw
+        ai = flops / nbytes
+        out["ridge_flops_per_byte"] = round(ridge, 1)
+        out["bandwidth_bound"] = ai < ridge
+        # MFU ceiling if the step were perfectly streamed at full HBM bw
+        out["mfu_ceiling_at_bw"] = round(min(1.0, ai * bw / peak), 4)
+        # equivalently: the fastest possible step time is bytes/bw
+        out["min_step_time_us_at_bw"] = round(nbytes / bw * 1e6, 1)
+    return out
+
+
+def main() -> None:
+    import jax
+
+    dev = jax.devices()[0]
+    platform = f"{dev.platform}:{getattr(dev, 'device_kind', '?')}"
+    print(f"[roofline] platform {platform}", file=sys.stderr, flush=True)
+
+    results = {
+        "date_utc": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"
+        ),
+        "platform": platform,
+        "note": (
+            "bytes accessed / flops from XLA cost analysis of the exact "
+            "bench train steps; AI vs ridge point decides bandwidth- vs "
+            "compute-bound; mfu_ceiling_at_bw is the physics limit at "
+            "full HBM streaming"
+        ),
+        "stages": [],
+    }
+    for env_name, over, key in (
+        ("TicTacToe", {}, "tictactoe_mfu"),
+        ("HungryGeese", {"turn_based_training": False, "observation": False},
+         "geese_mfu"),
+    ):
+        print(f"[roofline] analyzing {env_name}...", file=sys.stderr, flush=True)
+        results["stages"].append(stage(env_name, over, key))
+
+    print(json.dumps(results, indent=2))
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%d_%H%M")
+    dest = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "captures", f"roofline_{stamp}.json",
+    )
+    with open(dest, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"[roofline] wrote {dest}", file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()
