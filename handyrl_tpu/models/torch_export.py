@@ -53,12 +53,6 @@ def _to_torch_dtype(torch, np_dtype) -> Any:
     return getattr(torch, _TORCH_DTYPES[name])
 
 
-def _einsum_letters(n: int) -> List[str]:
-    import string
-
-    return list(string.ascii_lowercase[:n])
-
-
 class _Interpreter:
     """Evaluate a jaxpr with torch tensors.  Every handler uses only
     torch ops the TorchScript ONNX exporter lowers to standard ONNX

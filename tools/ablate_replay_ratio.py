@@ -32,6 +32,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# honor HANDYRL_PLATFORM before any jax computation (the axon
+# sitecustomize pins the platform; env var alone cannot override it)
+from handyrl_tpu.utils import apply_platform_override  # noqa: E402
+
+apply_platform_override()
+
 RATIOS = (1, 4, 16, 64)
 
 

@@ -72,7 +72,11 @@ def run_one(env_name: str, device_path: bool, epochs: int, run_root: str,
     if device_path:
         train_args.update(
             {"device_rollout_games": 32, "device_replay": True,
-             "device_replay_slots": 256, "device_replay_k_steps": 32}
+             "device_replay_slots": 256, "device_replay_k_steps": 32,
+             # device-replay runs generate nothing on the host, so the
+             # win-rate books need the on-device evaluator to fill
+             # metrics.jsonl win_rate records
+             "device_eval_games": 64}
         )
     with open(os.path.join(run_dir, "config.yaml"), "w") as f:
         yaml.safe_dump(
