@@ -87,9 +87,14 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     # set supports_seq); turn off to force the step-scan path
     "seq_forward": True,
     # seq-mode attention implementation: 'auto' (Pallas masked flash
-    # attention on TPU, einsum elsewhere), 'flash', 'einsum', or 'ring'
-    # (sequence-parallel masked ring attention — needs an 'sp' mesh axis)
+    # attention on TPU when the window is >= flash_min_t, einsum
+    # elsewhere/shorter), 'flash', 'einsum', or 'ring' (sequence-parallel
+    # masked ring attention — needs an 'sp' mesh axis)
     "seq_attention": "auto",
+    # auto-mode crossover: windows shorter than this use the exact einsum
+    # path even on TPU (the O(T^2) term is tiny and XLA-fusable at short
+    # T; the Pallas kernel pays fixed launch/block overhead)
+    "flash_min_t": 128,
     # 'bfloat16' runs the forward/backward compute in bf16 (MXU rate)
     # with fp32 master weights; 'float32' is exact
     "compute_dtype": "float32",
@@ -158,6 +163,8 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
             f"train_args.seq_attention={train['seq_attention']!r} "
             "not one of ('auto', 'flash', 'einsum', 'ring')"
         )
+    if int(train["flash_min_t"]) < 1:
+        raise ValueError("train_args.flash_min_t must be >= 1")
     if train["compute_dtype"] not in ("float32", "bfloat16"):
         raise ValueError(
             f"train_args.compute_dtype={train['compute_dtype']!r} "

@@ -126,9 +126,21 @@ def forward_prediction(module, params, batch: Dict[str, Any], args: Dict[str, An
         # seq_attention: 'einsum' (exact O(T^2) path), 'flash' (Pallas
         # masked flash-attention kernel), 'ring' (sequence-parallel masked
         # ring attention over the mesh's 'sp' axis — args['_mesh'], set by
-        # TrainContext), or 'auto' (flash on TPU backends)
+        # TrainContext), or 'auto': flash on TPU only when the window is
+        # long enough to amortize the kernel — at short T the O(T^2)
+        # einsum is tiny and XLA-fusable while the Pallas kernel pays
+        # fixed block/launch overhead (the round-4 fp32≈bf16 finding
+        # already showed the d1024/T64 step is not matmul-bound).  The
+        # crossover default is conservative (128, kernel-side bench
+        # crossover from the r3 flash battery: 1.54x at T1024, parity
+        # around T128-256); override with train_args.flash_min_t, and the
+        # armed on-chip comparison (tools/tune_transformer.py
+        # d1024_B64_T64_{bf16,einsum}) re-pins it when the lease allows.
         mode = args.get("seq_attention", "auto")
-        use_flash = mode == "flash" or (mode == "auto" and jax.default_backend() == "tpu")
+        if mode == "auto" and jax.default_backend() == "tpu":
+            use_flash = T >= int(args.get("flash_min_t", 128))
+        else:
+            use_flash = mode == "flash"
         ring_mesh = None
         if mode == "ring":
             # mesh shape + T divisibility are validated up front by

@@ -57,7 +57,7 @@ BASE = {
 # sampling-path difference the VERDICT asks about.
 PAIRS = {
     "ParallelTicTacToe": {"epochs": 60},
-    "HungryGeese": {"epochs": 40},
+    "HungryGeese": {"epochs": 20},
 }
 
 
