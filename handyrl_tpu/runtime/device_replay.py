@@ -42,11 +42,19 @@ fully resident; one target player uniform per window
 the episode end reproduces make_batch exactly (prob 1, action-mask all
 illegal, value frozen at the outcome, progress 1, episode_mask 0) —
 pinned key-by-key against make_batch by tests/test_device_replay.py.
-Two deliberate deviations, both documented here: recency bias is the
+Two deliberate deviations, both MEASURED (round 5): recency bias is the
 ring's finite capacity (oldest data falls out) instead of the reference's
 per-episode acceptance curve (train.py:292-303), and window starts are
 uniform over eligible STEPS, which weights episodes by the number of
-windows they contain rather than uniformly.
+windows they contain rather than uniformly.  Controlled comparison
+(tools/ablate_sampler.py: one generation engine, one TrainContext, equal
+updates and rollout cadence, seeded end-to-end, only the sampler swapped
+— host EpisodeStore semantics vs these rings — HungryGeese, 300 updates,
+2 seeds): late-mean win points vs random, ring − host = **−0.037 and
+−0.017** (mean −0.027; host arm's own seed spread 0.016).  A small,
+consistently-signed cost of ~0.02-0.04 win points at this budget —
+the price of uniform-step windows + capacity recency, known and bounded
+(docs/captures/sampler_ablation_2026-08-02_{0739,0756}.json).
 
 Two window modes (checked at construction, dispatched by
 ``turn_based_training``):
