@@ -1021,7 +1021,15 @@ TRANSFORMER_TPU_NET_ARGS = {"d_model": 1024, "n_heads": 16, "n_layers": 8,
 TRANSFORMER_TPU_OVERRIDES = {"batch_size": 64, "burn_in_steps": 2,
                              "forward_steps": 62, "observation": True,
                              "compute_dtype": "bfloat16",
-                             "seq_attention": "flash"}
+                             # the 2026-08-02 on-chip comparison settled
+                             # flash-vs-einsum at this pinned shape: einsum
+                             # 18.6 updates/s (MFU 0.48) vs flash 13.5
+                             # (0.347) — at T64 the O(T^2) term is tiny and
+                             # XLA-fusable while the Pallas kernel pays fixed
+                             # launch/block overhead.  'auto' (flash_min_t
+                             # 128) picks the same; pinned explicitly so the
+                             # stage measures one known program
+                             "seq_attention": "einsum"}
 
 KNOWN_STAGES = (
     "tictactoe", "device-selfplay", "geese-device-selfplay", "geese-gen",
@@ -1417,8 +1425,9 @@ def main() -> None:
     # (models/transformer.py) scaled to matmul-dominated shapes via
     # env_args.net_args, through the SAME TrainContext path as every other
     # stage — real env (Geister windows, ~full-length episodes), real
-    # losses, Adam, whole-window flash attention, bf16 compute with fp32
-    # master weights.  The game-net MFUs (tictactoe/geese/northstar2) are
+    # losses, Adam, whole-window einsum attention (the measured winner at
+    # the pinned T64 shape; flash wins at T >= flash_min_t), bf16 compute
+    # with fp32 master weights.  The game-net MFUs (tictactoe/geese/northstar2) are
     # honest-but-tiny because those convs are tiny; this stage states the
     # framework's MFU where the model actually offers the MXU work.
     def stage_transformer():
@@ -1426,11 +1435,12 @@ def main() -> None:
 
         on_tpu = jax.default_backend() == "tpu"
         if on_tpu:
-            # shapes from the 2026-08-01 v5e sweep (tools/tune_transformer.py):
+            # shapes from the 2026-08-01/02 v5e sweeps (tools/tune_transformer.py):
             # T64 windows amortize the step's fixed ops best (d768: MFU 0.311
             # vs 0.253 at T32), doubling batch was flat (0.247 — already
-            # device-bound at B64), and widening to d1024 lifts the matmul
-            # share further: MFU 0.347 at 13.5 updates/s
+            # device-bound at B64), widening to d1024 lifts the matmul share
+            # (0.347 under flash), and einsum attention at this short window
+            # lifts it again: 18.6 updates/s, MFU 0.48 (2026-08-02)
             net_args = TRANSFORMER_TPU_NET_ARGS
             t_over = dict(TRANSFORMER_TPU_OVERRIDES)
         else:

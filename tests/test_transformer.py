@@ -87,10 +87,12 @@ def test_stateful_model_without_observation_fails_fast():
 def test_bench_tpu_transformer_config_traces():
     """Abstractly evaluate the EXACT train program the bench's TPU-gated
     transformer stage compiles on-chip (d1024/L8/H16, B64, T64, bf16,
-    flash attention).  The stage never executes in CI, so without this
-    trace a shape bug in the big config would first surface mid-capture
-    on a live chip lease.  eval_shape runs the full trace — forward with
-    masked flash attention, losses, grads, Adam — without lowering or
+    einsum attention — the measured winner at this short window; the
+    flash path's kernel shapes are covered by the battery in
+    tests/test_flash_attention.py).  The stage never executes in CI, so without
+    this trace a shape bug in the big config would first surface
+    mid-capture on a live chip lease.  eval_shape runs the full trace —
+    forward, attention, losses, grads, Adam — without lowering or
     allocating the 134M-param state."""
     import sys
     from pathlib import Path

@@ -39,9 +39,11 @@ VARIANTS = [
     ("d1024_B64_T64_bf16", {**BASE, "batch_size": 64, "forward_steps": 62},
      D1024),                                                                    # 0.347
     # fp32 ~= bf16 at these shapes says the step is not matmul-dtype-bound;
-    # candidate culprit is the flash kernel at SHORT windows (it proved
+    # candidate culprit was the flash kernel at SHORT windows (it proved
     # itself at T1024; at T64/window-32 the O(T^2) einsum is tiny and
-    # XLA-fusable) — this variant settles flash-vs-einsum on the pinned shape
+    # XLA-fusable).  SETTLED on-chip 2026-08-02: einsum 18.6 ups / MFU 0.48
+    # vs flash 13.5 / 0.347 at the pinned shape — the bench stage now pins
+    # einsum and auto-mode's flash_min_t=128 rule stands
     ("d1024_B64_T64_einsum",
      {**BASE, "seq_attention": "einsum", "batch_size": 64, "forward_steps": 62},
      D1024),
