@@ -1016,7 +1016,11 @@ def _flash_attention_bench(duration: float = 3.0):
 # EXACT program the driver bench will compile on the TPU — the stage is
 # TPU-gated, so without that trace a shape bug would first surface
 # mid-capture; tests/test_transformer.py::test_bench_tpu_transformer_config_traces)
-TRANSFORMER_TPU_NET_ARGS = {"d_model": 1024, "n_heads": 16, "n_layers": 8,
+# width sweep 2026-08-02 (all einsum, B64/T64): d1024 0.494, d1024/L16
+# 0.489 (depth flat), d1536 0.597, d2048 0.185 (HBM pressure — remat/
+# spill collapse at 20 TFLOP/step), d1024/B128 0.45 (batch flat).
+# Width is the MFU lever until memory pressure bites; d1536 is the knee.
+TRANSFORMER_TPU_NET_ARGS = {"d_model": 1536, "n_heads": 16, "n_layers": 8,
                             "memory_len": 32}
 TRANSFORMER_TPU_OVERRIDES = {"batch_size": 64, "burn_in_steps": 2,
                              "forward_steps": 62, "observation": True,

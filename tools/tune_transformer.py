@@ -25,6 +25,9 @@ import bench  # noqa: E402  (repo root on path)
 
 D768 = {"d_model": 768, "n_heads": 12, "n_layers": 8, "memory_len": 32}
 D1024 = {"d_model": 1024, "n_heads": 16, "n_layers": 8, "memory_len": 32}
+D1024L16 = {"d_model": 1024, "n_heads": 16, "n_layers": 16, "memory_len": 32}
+D1536 = {"d_model": 1536, "n_heads": 16, "n_layers": 8, "memory_len": 32}
+D2048 = {"d_model": 2048, "n_heads": 16, "n_layers": 8, "memory_len": 32}
 BASE = {"burn_in_steps": 2, "observation": True, "seq_attention": "flash",
         "compute_dtype": "bfloat16"}
 
@@ -46,6 +49,20 @@ VARIANTS = [
     # einsum and auto-mode's flash_min_t=128 rule stands
     ("d1024_B64_T64_einsum",
      {**BASE, "seq_attention": "einsum", "batch_size": 64, "forward_steps": 62},
+     D1024),
+    # --- beyond-0.49 sweep (2026-08-02): with attention settled on einsum
+    # at T64, the remaining MFU lever is matmul size.  All einsum.
+    ("d1024L16_B64_T64_einsum",
+     {**BASE, "seq_attention": "einsum", "batch_size": 64, "forward_steps": 62},
+     D1024L16),
+    ("d1536_B64_T64_einsum",
+     {**BASE, "seq_attention": "einsum", "batch_size": 64, "forward_steps": 62},
+     D1536),
+    ("d2048_B64_T64_einsum",
+     {**BASE, "seq_attention": "einsum", "batch_size": 64, "forward_steps": 62},
+     D2048),
+    ("d1024_B128_T64_einsum",
+     {**BASE, "seq_attention": "einsum", "batch_size": 128, "forward_steps": 62},
      D1024),
 ]
 
