@@ -599,22 +599,47 @@ def _timed_pipeline_train(pipe, ctx, state, duration: float, on_timed_start=None
 
 
 def _pipeline_bench(train_res, duration: float):
-    """Train through the threaded BatchPipeline (replay -> make_batch ->
+    """Train through the configured batch pipeline (default: shared-memory
+    batcher PROCESSES, runtime/shm_batch.py; replay -> make_batch ->
     device_put -> step) and measure input starvation (north-star: learner
-    never input-starved)."""
-    from handyrl_tpu.runtime.trainer import BatchPipeline
+    never input-starved) plus the per-stage time breakdown that says
+    WHICH stage any starvation comes from."""
+    from handyrl_tpu.runtime.trainer import make_pipeline
 
     args, ctx, store = train_res["args"], train_res["ctx"], train_res["store"]
     stop = threading.Event()
-    pipe = BatchPipeline(args, store, ctx, stop)
+    pipe = make_pipeline(args, store, ctx, stop)
     pipe.start()
     state = ctx.init_state(train_res["model"].variables["params"])
-    n, wait_s, dt = _timed_pipeline_train(pipe, ctx, state, duration)
+    window = {}
+
+    # snapshot the cumulative stage counters exactly at the timed window's
+    # edges, so warm-up assembly never lands in the breakdown
+    n, wait_s, dt = _timed_pipeline_train(
+        pipe, ctx, state, duration,
+        on_timed_start=lambda: window.update(t0=pipe.stats()),
+        on_timed_end=lambda: window.update(t1=pipe.stats()),
+    )
     stop.set()
+    pipe.stop()
+    s0, s1 = window.get("t0", {}), window.get("t1", {})
+    from handyrl_tpu.runtime.trainer import PIPE_STAT_KEYS
+
+    stages = {
+        key: round(s1.get(key, 0.0) - s0.get(key, 0.0), 4)
+        for key in PIPE_STAT_KEYS
+    }
+    gets = s1.get("gets", 0.0) - s0.get("gets", 0.0)
+    stages["device_queue_depth"] = round(
+        (s1.get("device_queue_depth_sum", 0.0)
+         - s0.get("device_queue_depth_sum", 0.0)) / gets, 3
+    ) if gets else None
+    stages["mode"] = s1.get("mode")
     return {
         "updates_per_sec": n / dt,
         "trained_env_steps_per_sec": n * args["batch_size"] * args["forward_steps"] / dt,
         "input_wait_frac": wait_s / dt,
+        "stages": stages,
     }
 
 
@@ -716,7 +741,7 @@ def _concurrent_northstar_bench(train_res, duration: float,
     from handyrl_tpu.envs import make_env
     from handyrl_tpu.runtime import EpisodeStore
     from handyrl_tpu.runtime.device_rollout import StreamingDeviceRollout
-    from handyrl_tpu.runtime.trainer import BatchPipeline
+    from handyrl_tpu.runtime.trainer import make_pipeline
 
     args, ctx, module = train_res["args"], train_res["ctx"], train_res["module"]
     env = make_env(args["env"])
@@ -763,7 +788,7 @@ def _concurrent_northstar_bench(train_res, duration: float,
         }
 
     pipe_stop = threading.Event()
-    pipe = BatchPipeline(args, store, ctx, pipe_stop)
+    pipe = make_pipeline(args, store, ctx, pipe_stop)
     pipe.start()
     state = ctx.init_state(params)
 
@@ -786,6 +811,7 @@ def _concurrent_northstar_bench(train_res, duration: float,
     )
     stop.set()
     pipe_stop.set()
+    pipe.stop()
     thread.join(timeout=120.0)
     selfplay_rate = (counters["steps1"] - counters["steps0"]) / dt
     # the lanes shard over the mesh: the aggregate rate divides over every
@@ -1025,14 +1051,19 @@ TRANSFORMER_TPU_NET_ARGS = {"d_model": 1536, "n_heads": 16, "n_layers": 8,
 TRANSFORMER_TPU_OVERRIDES = {"batch_size": 64, "burn_in_steps": 2,
                              "forward_steps": 62, "observation": True,
                              "compute_dtype": "bfloat16",
-                             # the 2026-08-02 on-chip comparison settled
-                             # flash-vs-einsum at this pinned shape: einsum
-                             # 18.6 updates/s (MFU 0.48) vs flash 13.5
-                             # (0.347) — at T64 the O(T^2) term is tiny and
-                             # XLA-fusable while the Pallas kernel pays fixed
-                             # launch/block overhead.  'auto' (flash_min_t
-                             # 128) picks the same; pinned explicitly so the
-                             # stage measures one known program
+                             # flash-vs-einsum was settled on-chip at the
+                             # d1024 pin (2026-08-02): einsum 18.6 updates/s
+                             # (MFU 0.48) vs flash 13.5 (0.347) — at T64 the
+                             # O(T^2) term is tiny and XLA-fusable while the
+                             # Pallas kernel pays fixed launch/block
+                             # overhead.  The d1536 re-pin has only run
+                             # through tools/tune_transformer.py (MFU 0.597,
+                             # einsum) — not yet full-suite-captured; the
+                             # next capture should confirm einsum still wins
+                             # at this width.  'auto' (flash_min_t 128)
+                             # picks einsum at T64 regardless; pinned
+                             # explicitly so the stage measures one known
+                             # program
                              "seq_attention": "einsum"}
 
 KNOWN_STAGES = (
@@ -1291,6 +1322,10 @@ def main() -> None:
         pipe = _pipeline_bench(gt, T_TRAIN)
         result["extra"]["geese_pipeline_updates_per_sec"] = _sig(pipe["updates_per_sec"])
         result["extra"]["geese_input_wait_frac"] = round(pipe["input_wait_frac"], 4)
+        # per-stage breakdown (seconds inside the timed window): sample /
+        # assemble / free-slot wait / ready wait / device put, plus the
+        # mean device-queue depth and which plane ran (shm or thread)
+        result["extra"]["geese_pipeline_stages"] = pipe["stages"]
         return gt
 
     gt = _run_stage(result, "geese-train", stage_geese_train)

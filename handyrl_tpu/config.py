@@ -55,6 +55,16 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     },
     "inference_batch_size": 64,
     "prefetch_batches": 2,
+    # batch-assembly plane: 'shm' (default) forks num_batchers PROCESSES
+    # that write columnar batches into shared-memory ring slots — GIL-free,
+    # zero-copy on the consumer side (runtime/shm_batch.py); 'thread' keeps
+    # the in-process threaded batchers (the portable fallback, also used
+    # automatically when the shm plane cannot start)
+    "batch_pipeline": "shm",
+    # shared-memory ring depth, in slots of one (B, T, P, ...) batch each;
+    # clamped up to fused_steps + 2 so the fused device-put can always
+    # drain a full group while one slot stays in flight
+    "shm_slots": 6,
     # k SGD updates fused under one lax.scan per device call (amortizes
     # per-call dispatch for small models); 1 = one jit call per update.
     # Semantics are identical: lr is already held constant within an epoch.
@@ -136,6 +146,13 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError("train_args.burn_in_steps must be >= 0")
     if train["fused_steps"] < 1:
         raise ValueError("train_args.fused_steps must be >= 1")
+    if train["batch_pipeline"] not in ("shm", "thread"):
+        raise ValueError(
+            f"train_args.batch_pipeline={train['batch_pipeline']!r} "
+            "not one of ('shm', 'thread')"
+        )
+    if int(train["shm_slots"]) < 2:
+        raise ValueError("train_args.shm_slots must be >= 2")
     if train["device_rollout_games"] < 0:
         raise ValueError("train_args.device_rollout_games must be >= 0")
     if train["device_eval_games"] < 0:

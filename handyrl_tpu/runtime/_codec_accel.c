@@ -448,6 +448,151 @@ static PyObject *c_loads(PyObject *self, PyObject *arg) {
     return obj;
 }
 
+/* ---------------- columnar batch fill ----------------
+ *
+ * Hot path of runtime/batch.py make_batch: each sampled window writes its
+ * per-key arrays into a (B, T, ...) output at [b, lo:lo+rows].  For a
+ * C-contiguous destination that region is one contiguous byte range, so
+ * the whole fancy-indexed numpy assignment (ufunc dispatch, broadcasting
+ * machinery, per-call allocation) collapses to a bounds-checked memcpy —
+ * fill_column does a whole per-key column (all windows) in one call, and
+ * fill_rows broadcasts the value-frozen-at-outcome row.  Python-side
+ * (batch.py) pre-checks dtype equality and falls back to numpy on any
+ * mismatch; these functions still validate shapes, bounds and itemsize
+ * so a buggy caller gets ValueError, never memory corruption.  Buffer
+ * protocol only — no numpy C-API, same as the codec.
+ */
+
+static Py_ssize_t row_bytes_of(const Py_buffer *b, int from) {
+    Py_ssize_t n = b->itemsize;
+    for (int i = from; i < b->ndim; i++) n *= b->shape[i];
+    return n;
+}
+
+static int fmt_equal(const char *a, const char *b) {
+    /* NULL format means "B" (unsigned bytes) per the buffer protocol */
+    if (!a) a = "B";
+    if (!b) b = "B";
+    return strcmp(a, b) == 0;
+}
+
+static PyObject *c_fill_rows(PyObject *self, PyObject *args) {
+    /* broadcast one row (shape == dst.shape[2:]) into dst[b, lo:hi] —
+     * the "value frozen at the outcome past episode end" write */
+    PyObject *dsto, *rowo;
+    Py_ssize_t b, lo, hi;
+    if (!PyArg_ParseTuple(args, "OnnnO", &dsto, &b, &lo, &hi, &rowo)) return NULL;
+    Py_buffer db, sb;
+    if (PyObject_GetBuffer(dsto, &db,
+                           PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS | PyBUF_FORMAT) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(rowo, &sb, PyBUF_C_CONTIGUOUS | PyBUF_FORMAT) < 0) {
+        PyBuffer_Release(&db);
+        return NULL;
+    }
+    int ok = db.ndim >= 2 && sb.ndim == db.ndim - 2 &&
+             db.itemsize == sb.itemsize && fmt_equal(db.format, sb.format);
+    for (int i = 0; ok && i < sb.ndim; i++) ok = sb.shape[i] == db.shape[i + 2];
+    ok = ok && b >= 0 && b < db.shape[0] && lo >= 0 && hi >= lo && hi <= db.shape[1];
+    if (!ok) {
+        PyBuffer_Release(&db);
+        PyBuffer_Release(&sb);
+        PyErr_SetString(PyExc_ValueError,
+                        "fill_rows: dst/row shape, dtype or bounds mismatch");
+        return NULL;
+    }
+    Py_ssize_t rb = row_bytes_of(&db, 2);
+    char *p = (char *)db.buf + (size_t)(b * db.shape[1] + lo) * (size_t)rb;
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t r = lo; r < hi; r++, p += rb)
+        memcpy(p, sb.buf, (size_t)rb);
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&db);
+    PyBuffer_Release(&sb);
+    Py_RETURN_NONE;
+}
+
+static PyObject *c_fill_column(PyObject *self, PyObject *args) {
+    /* fill_column(dst, los, srcs): dst[b, los[b]:los[b]+len(srcs[b])] =
+     * srcs[b] for every b — the whole per-key column of a batch in ONE
+     * call.  Acquiring the destination buffer once and looping the
+     * windows in C is what beats numpy here: per-item buffer-protocol
+     * acquisitions cost more on large columns than the fancy-index
+     * assignment they replace.  Two phases: validate + acquire every
+     * source with the GIL held (shape, bounds, itemsize AND format — a
+     * same-width different dtype must raise, never be bit-reinterpreted),
+     * then run all memcpys with the GIL RELEASED, so multi-megabyte
+     * column copies never stall the learner's other threads. */
+    PyObject *dsto, *los, *srcs;
+    if (!PyArg_ParseTuple(args, "OOO", &dsto, &los, &srcs)) return NULL;
+    Py_buffer db;
+    if (PyObject_GetBuffer(dsto, &db,
+                           PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS | PyBUF_FORMAT) < 0)
+        return NULL;
+    PyObject *lof = PySequence_Fast(los, "fill_column: los not a sequence");
+    PyObject *srf = lof ? PySequence_Fast(srcs, "fill_column: srcs not a sequence") : NULL;
+    if (!srf) {
+        Py_XDECREF(lof);
+        PyBuffer_Release(&db);
+        return NULL;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(lof);
+    int ok = db.ndim >= 2 && n == PySequence_Fast_GET_SIZE(srf) && n <= db.shape[0];
+    Py_buffer *sbs = NULL;
+    Py_ssize_t *offs = NULL;
+    Py_ssize_t acquired = 0;
+    if (ok && n > 0) {
+        sbs = PyMem_Malloc((size_t)n * sizeof(Py_buffer));
+        offs = PyMem_Malloc((size_t)n * sizeof(Py_ssize_t));
+        if (!sbs || !offs) {
+            PyMem_Free(sbs);
+            PyMem_Free(offs);
+            Py_DECREF(lof);
+            Py_DECREF(srf);
+            PyBuffer_Release(&db);
+            return PyErr_NoMemory();
+        }
+    }
+    Py_ssize_t rb = row_bytes_of(&db, 2);
+    for (Py_ssize_t b = 0; ok && b < n; b++) {
+        Py_ssize_t lo = PyLong_AsSsize_t(PySequence_Fast_GET_ITEM(lof, b));
+        if (lo == -1 && PyErr_Occurred()) { ok = 0; break; }
+        Py_buffer *sb = &sbs[b];
+        if (PyObject_GetBuffer(PySequence_Fast_GET_ITEM(srf, b), sb,
+                               PyBUF_C_CONTIGUOUS | PyBUF_FORMAT) < 0) { ok = 0; break; }
+        acquired = b + 1;
+        int good = sb->ndim == db.ndim - 1 && sb->itemsize == db.itemsize &&
+                   fmt_equal(db.format, sb->format);
+        for (int i = 1; good && i < sb->ndim; i++)
+            good = sb->shape[i] == db.shape[i + 1];
+        good = good && lo >= 0 && sb->shape[0] <= db.shape[1] - lo;
+        offs[b] = (b * db.shape[1] + lo) * rb;
+        ok = good;
+    }
+    if (ok && n > 0 && rb > 0) {
+        Py_BEGIN_ALLOW_THREADS
+        for (Py_ssize_t b = 0; b < n; b++)
+            if (sbs[b].len > 0)
+                memcpy((char *)db.buf + (size_t)offs[b], sbs[b].buf,
+                       (size_t)sbs[b].len);
+        Py_END_ALLOW_THREADS
+    }
+    for (Py_ssize_t b = 0; b < acquired; b++)
+        PyBuffer_Release(&sbs[b]);
+    PyMem_Free(sbs);
+    PyMem_Free(offs);
+    Py_DECREF(lof);
+    Py_DECREF(srf);
+    PyBuffer_Release(&db);
+    if (!ok) {
+        if (!PyErr_Occurred())
+            PyErr_SetString(PyExc_ValueError,
+                            "fill_column: dst/src shape, dtype or bounds mismatch");
+        return NULL;
+    }
+    Py_RETURN_NONE;
+}
+
 /* ---------------- module ---------------- */
 
 static PyObject *c_init(PyObject *self, PyObject *args) {
@@ -482,6 +627,10 @@ static PyMethodDef methods[] = {
      "init(CodecError, numpy) — bind the error class and numpy callables"},
     {"dumps", c_dumps, METH_O, "encode to wire bytes"},
     {"loads", c_loads, METH_O, "decode wire bytes"},
+    {"fill_rows", c_fill_rows, METH_VARARGS,
+     "fill_rows(dst, b, lo, hi, row) — broadcast row into dst[b, lo:hi]"},
+    {"fill_column", c_fill_column, METH_VARARGS,
+     "fill_column(dst, los, srcs) — dst[b, los[b]:...] = srcs[b] for every b"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -24,6 +24,13 @@ from pathlib import Path
 
 _SRC = Path(__file__).with_name("_codec_accel.c")
 
+# every symbol the runtime dispatches to: the wire codec pair plus the
+# columnar batch-fill kernels (runtime/batch.py).  The source-hash cache
+# name makes a stale .so unloadable in practice, but a hand-copied or
+# truncated binary must fail HERE, loudly, not as AttributeError deep in
+# a batcher process.
+_REQUIRED_SYMBOLS = ("init", "dumps", "loads", "fill_rows", "fill_column")
+
 
 def _cache_dir() -> Path:
     pkg = _SRC.parent
@@ -73,4 +80,9 @@ def load():
     spec = importlib.util.spec_from_file_location("handyrl_tpu.runtime._codec_accel", so)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    missing = [s for s in _REQUIRED_SYMBOLS if not hasattr(mod, s)]
+    if missing:
+        raise ImportError(
+            f"_codec_accel at {so} lacks {missing}; rebuild from _codec_accel.c"
+        )
     return mod

@@ -31,14 +31,80 @@ Episode columnar format (produced by runtime/generation.py):
 
 from __future__ import annotations
 
+import os
 import random
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
 from ..utils import tree_concat, tree_map
+from . import codec
 from .replay import decompress_block
+
+
+def _fill_accel():
+    """The C fast path for the per-window columnar fill (fill_window /
+    fill_rows in _codec_accel.c), or None.  Rides the codec accelerator's
+    build/load decision; ``HANDYRL_NO_FILL_ACCEL=1`` forces the numpy
+    path independently (parity tests flip exactly this switch)."""
+    if os.environ.get("HANDYRL_NO_FILL_ACCEL", "").strip().lower() not in (
+        "", "0", "false", "no",
+    ):
+        return None
+    acc = codec.get_accel()
+    if acc is not None and all(
+        hasattr(acc, sym) for sym in ("fill_rows", "fill_column")
+    ):
+        return acc
+    return None
+
+
+_ACCEL = _fill_accel()
+
+
+def _broadcast_rows(dst: np.ndarray, b: int, lo: int, hi: int, row: np.ndarray) -> None:
+    """dst[b, lo:hi] = row (one row broadcast across hi-lo steps)."""
+    if hi <= lo:
+        return
+    if (
+        _ACCEL is not None
+        and dst.dtype == row.dtype
+        and row.shape == dst.shape[2:]
+        and dst.flags.c_contiguous
+        and row.flags.c_contiguous
+    ):
+        _ACCEL.fill_rows(dst, b, lo, hi, row)
+    else:
+        dst[b, lo:hi] = row
+
+
+def _fill_column(dst: np.ndarray, los: List[int], srcs: List[np.ndarray]) -> None:
+    """dst[b, los[b]:los[b]+len(srcs[b])] = srcs[b] for every window b.
+
+    One C call per COLUMN (destination buffer acquired once, then a plain
+    memcpy per window) — per-window C calls pay two buffer-protocol
+    acquisitions each, which measures SLOWER than numpy's fancy-index
+    assignment on large columns.  Dtype/layout uniformity within a column
+    is a pipeline invariant, so only srcs[0] is pre-checked; the C kernel
+    still validates every src's shape/itemsize/bounds (memory safety) and
+    any violation falls back to the numpy loop, which re-raises genuine
+    shape bugs.  BufferError/TypeError cover what the kernel raises for
+    a non-contiguous later src or a non-int lo — same fallback."""
+    if (
+        _ACCEL is not None
+        and srcs
+        and dst.dtype == srcs[0].dtype
+        and dst.flags.c_contiguous
+        and srcs[0].flags.c_contiguous
+    ):
+        try:
+            _ACCEL.fill_column(dst, los, srcs)
+            return
+        except (ValueError, TypeError, BufferError):
+            pass
+    for b, (lo, src) in enumerate(zip(los, srcs)):
+        dst[b, lo : lo + src.shape[0]] = src
 
 
 def _concat_columns(blocks: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -119,7 +185,84 @@ def _assemble_one(window: Dict[str, Any], args: Dict[str, Any]) -> Dict[str, Any
     }
 
 
-def make_batch(windows: List[Dict[str, Any]], args: Dict[str, Any]) -> Dict[str, Any]:
+# per-key default values: these ARE the padding semantics (zeros before
+# the window; after episode end selected_prob 1, action_mask all-illegal
+# 1e32, progress 1, episode_mask 0, value frozen at the outcome by an
+# explicit fill-pass write).  Shared by the allocating path (make_batch)
+# and the slot-reset path (fill_batch into a reused shared-memory slot).
+_KEY_DEFAULTS = {"selected_prob": 1.0, "action_mask": 1e32, "progress": 1.0}
+
+
+def _alloc_out(c0: Dict[str, Any], B: int, T: int) -> Dict[str, Any]:
+    def alloc(leaf, fill=0.0, dtype=np.float32):
+        shape = (B, T) + tuple(leaf.shape[1:])
+        if fill == 0.0:
+            return np.zeros(shape, dtype)
+        return np.full(shape, fill, dtype)
+
+    return {
+        "observation": tree_map(lambda x: alloc(x, 0.0, x.dtype), c0["obs"]),
+        "selected_prob": alloc(c0["prob"], _KEY_DEFAULTS["selected_prob"]),
+        "value": alloc(c0["value"]),
+        "action": alloc(c0["action"], 0, np.int32),
+        "outcome": np.zeros((B, 1) + tuple(c0["outcome"].shape[1:]), np.float32),
+        "reward": alloc(c0["reward"]),
+        "return": alloc(c0["ret"]),
+        "episode_mask": np.zeros((B, T, 1, 1), np.float32),
+        "turn_mask": alloc(c0["tmask"]),
+        "observation_mask": alloc(c0["omask"]),
+        "action_mask": alloc(c0["amask"], _KEY_DEFAULTS["action_mask"]),
+        "progress": alloc(c0["progress"], _KEY_DEFAULTS["progress"]),
+    }
+
+
+def reset_out(out: Dict[str, Any]) -> None:
+    """Restore a preallocated/reused output batch to the padding defaults
+    (what a fresh _alloc_out would hold) — required before every
+    fill into a recycled shared-memory slot."""
+    for key, arr in out.items():
+        if key == "observation":
+            for leaf in jax.tree.leaves(arr):
+                leaf.fill(0)
+        else:
+            arr.fill(_KEY_DEFAULTS.get(key, 0.0))
+
+
+_COLUMN_FIELDS = (
+    ("selected_prob", "prob"),
+    ("value", "value"),
+    ("action", "action"),
+    ("reward", "reward"),
+    ("return", "ret"),
+    ("turn_mask", "tmask"),
+    ("observation_mask", "omask"),
+    ("action_mask", "amask"),
+    ("progress", "progress"),
+)
+
+
+def _fill_out(out: Dict[str, Any], cores: List[Dict[str, Any]], T: int) -> None:
+    los = [c["pad_b"] for c in cores]
+    obs_dsts = jax.tree.leaves(out["observation"])
+    obs_srcs = [jax.tree.leaves(c["obs"]) for c in cores]
+    for i, dst in enumerate(obs_dsts):
+        _fill_column(dst, los, [leaves[i] for leaves in obs_srcs])
+    for out_key, core_key in _COLUMN_FIELDS:
+        _fill_column(out[out_key], los, [c[core_key] for c in cores])
+    _fill_column(out["outcome"], [0] * len(cores), [c["outcome"] for c in cores])
+    for b, c in enumerate(cores):
+        lo, hi = los[b], los[b] + c["steps"]
+        # value frozen at the outcome past episode end (AFTER the column
+        # fill above, which wrote the in-window values)
+        _broadcast_rows(out["value"], b, hi, T, c["outcome"][0])
+        out["episode_mask"][b, lo:hi] = 1.0
+
+
+def make_batch(
+    windows: List[Dict[str, Any]],
+    args: Dict[str, Any],
+    out: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
     """Assemble B sampled windows into one (B, T, P, ...) numpy batch.
 
     Each window writes its unpadded slice directly into preallocated
@@ -129,51 +272,27 @@ def make_batch(windows: List[Dict[str, Any]], args: Dict[str, Any]) -> Dict[str,
     allocation + one copy per key instead of the np.pad-per-array +
     tree_stack version this replaces, which dominated the host-side batch
     assembly profile and starved the learner on HungryGeese-sized
-    observations.
+    observations.  The per-window copies go through the C fill kernels
+    (_codec_accel.c fill_window/fill_rows) when available.
+
+    ``out``: a preallocated batch dict (e.g. numpy views over a
+    shared-memory ring slot, runtime/shm_batch.py) to fill IN PLACE
+    instead of allocating; it is reset to the padding defaults first so
+    a recycled slot carries no previous batch's rows.
     """
     B = len(windows)
     T = args["burn_in_steps"] + args["forward_steps"]
     cores = [_assemble_one(w, args) for w in windows]
-    c0 = cores[0]
-
-    def alloc(leaf, fill=0.0, dtype=np.float32):
-        shape = (B, T) + tuple(leaf.shape[1:])
-        if fill == 0.0:
-            return np.zeros(shape, dtype)
-        return np.full(shape, fill, dtype)
-
-    out = {
-        "observation": tree_map(lambda x: alloc(x, 0.0, x.dtype), c0["obs"]),
-        "selected_prob": alloc(c0["prob"], 1.0),
-        "value": alloc(c0["value"]),
-        "action": alloc(c0["action"], 0, np.int32),
-        "outcome": np.zeros((B, 1) + tuple(c0["outcome"].shape[1:]), np.float32),
-        "reward": alloc(c0["reward"]),
-        "return": alloc(c0["ret"]),
-        "episode_mask": np.zeros((B, T, 1, 1), np.float32),
-        "turn_mask": alloc(c0["tmask"]),
-        "observation_mask": alloc(c0["omask"]),
-        "action_mask": alloc(c0["amask"], 1e32),
-        "progress": alloc(c0["progress"], 1.0),
-    }
-
-    for b, c in enumerate(cores):
-        lo, hi = c["pad_b"], c["pad_b"] + c["steps"]
-        sl = slice(lo, hi)
-        for dst, leaf in zip(
-            jax.tree.leaves(out["observation"]), jax.tree.leaves(c["obs"])
-        ):
-            dst[b, sl] = leaf
-        out["selected_prob"][b, sl] = c["prob"]
-        out["value"][b, sl] = c["value"]
-        out["value"][b, hi:] = c["outcome"]  # frozen at outcome past the end
-        out["action"][b, sl] = c["action"]
-        out["outcome"][b] = c["outcome"]
-        out["reward"][b, sl] = c["reward"]
-        out["return"][b, sl] = c["ret"]
-        out["episode_mask"][b, sl] = 1.0
-        out["turn_mask"][b, sl] = c["tmask"]
-        out["observation_mask"][b, sl] = c["omask"]
-        out["action_mask"][b, sl] = c["amask"]
-        out["progress"][b, sl] = c["progress"]
+    if out is None:
+        out = _alloc_out(cores[0], B, T)
+    else:
+        reset_out(out)
+    _fill_out(out, cores, T)
     return out
+
+
+def fill_batch(
+    windows: List[Dict[str, Any]], args: Dict[str, Any], out: Dict[str, Any]
+) -> Dict[str, Any]:
+    """make_batch into a preallocated output (shared-memory slot views)."""
+    return make_batch(windows, args, out=out)

@@ -220,3 +220,12 @@ if not _accel_disabled():
 
 dumps = _accel.dumps if _accel is not None else py_dumps
 loads = _accel.loads if _accel is not None else py_loads
+
+
+def get_accel():
+    """The loaded C accelerator module, or None when running pure Python.
+
+    Other runtime modules (batch.py's columnar fill) dispatch through this
+    instead of importing _codec_build themselves, so there is exactly one
+    build/load/disable decision for the whole process."""
+    return _accel

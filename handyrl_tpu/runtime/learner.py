@@ -79,6 +79,15 @@ class Learner:
 
         mesh = make_mesh(self.args.get("mesh"))
         self.trainer = Trainer(self.args, self.module, params, mesh)
+        # the CONFIGURED assembly plane (start() hasn't run yet, so an shm
+        # pipeline could still fall back to threads); metrics records read
+        # the live mode from batcher.stats() at each epoch, which is the
+        # attributable value — this line is the intent, not the outcome
+        self.batch_pipeline_mode = getattr(self.trainer.batcher, "mode", "thread")
+        print(
+            "batch pipeline: %s configured (num_batchers=%d)"
+            % (self.batch_pipeline_mode, self.args["num_batchers"])
+        )
         if self.model_epoch > 0:
             state_path = os.path.join(self.model_dir, "state.ckpt")
             if os.path.exists(state_path):
@@ -318,6 +327,13 @@ class Learner:
             record["loss"] = dict(self.trainer.last_loss)
         if self.trainer.stats:
             record.update(self.trainer.stats)
+        if self.trainer.device_replay is None:
+            # read the LIVE mode: an shm pipeline that fell back to
+            # threads at start() must not be recorded as shm
+            try:
+                record["pipeline"] = self.trainer.batcher.stats()["mode"]
+            except Exception:
+                record["pipeline"] = self.batch_pipeline_mode
         now = time.time()
         record.update(
             steps=steps,

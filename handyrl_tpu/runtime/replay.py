@@ -58,6 +58,20 @@ def _block_nbytes(cols) -> int:
     )
 
 
+def reset_block_cache() -> None:
+    """Re-create the decoded-block cache AND its lock.
+
+    Forked batcher processes (runtime/shm_batch.py) inherit this module's
+    state as of the fork instant — including a lock some parent thread
+    may have been holding.  A child that kept the inherited lock would
+    deadlock on its first decompress_block; calling this first in the
+    child makes the cache private and the lock fresh."""
+    global _BLOCK_CACHE, _BLOCK_CACHE_LOCK, _block_cache_bytes
+    _BLOCK_CACHE = OrderedDict()
+    _BLOCK_CACHE_LOCK = threading.Lock()
+    _block_cache_bytes = 0
+
+
 def decompress_block(blob: bytes) -> Dict[str, Any]:
     global _block_cache_bytes
     with _BLOCK_CACHE_LOCK:
@@ -85,10 +99,30 @@ class EpisodeStore:
         self.maximum_episodes = maximum_episodes
         self._episodes: deque = deque()
         self._lock = threading.Lock()
+        self._listeners: List[Any] = []
         self.total_added = 0
 
     def __len__(self) -> int:
         return len(self._episodes)
+
+    def subscribe(self, listener) -> None:
+        """Register ``listener(episodes)`` to be called with every batch of
+        newly added episodes (outside the store lock).  The shared-memory
+        batch pipeline uses this to mirror the stream into its batcher
+        processes' replica stores."""
+        with self._lock:
+            self._listeners.append(listener)
+
+    def unsubscribe(self, listener) -> None:
+        with self._lock:
+            if listener in self._listeners:
+                self._listeners.remove(listener)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Consistent copy of the current episode list (the episodes
+        themselves are immutable once stored: compressed block bytes)."""
+        with self._lock:
+            return list(self._episodes)
 
     def extend(self, episodes: List[Dict[str, Any]]) -> None:
         episodes = [e for e in episodes if e is not None]
@@ -98,6 +132,10 @@ class EpisodeStore:
             limit = self._memory_limited_max()
             while len(self._episodes) > limit:
                 self._episodes.popleft()
+            listeners = list(self._listeners)
+        for listener in listeners:
+            if episodes:
+                listener(episodes)
 
     def _memory_limited_max(self) -> int:
         """Shrink the buffer under memory pressure (reference train.py:474-483)."""

@@ -1,0 +1,513 @@
+"""GIL-free batch-assembly plane: batcher PROCESSES + shared-memory ring.
+
+The threaded BatchPipeline (runtime/trainer.py) keeps every make_batch on
+the learner process's GIL, where it contends with the inference engine,
+the worker threads and jax dispatch — measured at 3 updates/s against 376
+for the direct path on HungryGeese (BENCH_r05.json).  This module moves
+assembly off the GIL entirely, the IMPALA/HandyRL decoupled-batcher
+design point (reference train.py:271-401 forks num_batchers processes):
+
+    parent                                children (num_batchers processes)
+    ------                                ---------------------------------
+    EpisodeStore ──codec blobs──▶ feed_q ─▶ replica EpisodeStore
+                                            sample local_batch windows
+    free_q ◀──────────── slot indices ◀──── fill_batch into shm slot views
+    ready_q ◀─ (slot, stage timings) ◀────┘
+    device-put thread: slot views ─▶ ctx.put_batch ─▶ device queue
+
+Zero-copy by construction: batches have fixed (B, T, P, ...) shapes
+(runtime/batch.py), so each ring slot is a preallocated columnar layout in
+one ``multiprocessing.shared_memory`` segment.  Children write into numpy
+views over their mapping; the parent wraps the SAME bytes as views and
+hands them to ``TrainContext.put_batch`` — no pickling and no host-side
+memcpy anywhere on the consumer path.  A slot is recycled only after
+``jax.block_until_ready`` on the device transfer, so an in-flight H2D DMA
+can never read a half-overwritten slot.
+
+Episodes travel to the children once, as wire-codec bytes (never pickle,
+matching the trust model of runtime/codec.py), and each child maintains
+its own recency-biased replica store — per-batch sampling then costs the
+parent nothing.  Every stage is timed (sample / assemble / free-slot wait
+/ ready wait / device put / device-queue depth) and surfaced through
+``stats()`` into metrics.jsonl and bench.py.
+"""
+
+from __future__ import annotations
+
+import atexit
+import multiprocessing as mp
+import os
+import queue as thqueue
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import codec
+from .batch import fill_batch, make_batch
+from .replay import EpisodeStore
+from .trainer import PIPE_STAT_KEYS
+
+_ALIGN = 64  # cache-line-align every leaf inside a slot
+
+
+def slot_spec(template: Dict[str, Any]):
+    """(nested spec, slot_bytes) for one batch.
+
+    The spec mirrors the batch dict structure with ndarray leaves replaced
+    by ``("leaf", shape, dtype_str, offset)``; containers are plain
+    dict/list/tuple nodes, so the whole spec is picklable for spawn-start
+    children and rebuilds identically on both sides of the fork (dict keys
+    are laid out sorted, matching jax's pytree flattening order)."""
+    offset = 0
+
+    def walk(node):
+        nonlocal offset
+        if isinstance(node, np.ndarray):
+            here = offset
+            offset += (node.nbytes + _ALIGN - 1) // _ALIGN * _ALIGN
+            return ("leaf", tuple(node.shape), node.dtype.str, here)
+        if isinstance(node, dict):
+            return ("dict", {k: walk(node[k]) for k in sorted(node)})
+        if isinstance(node, (list, tuple)):
+            return ("seq", isinstance(node, tuple), [walk(x) for x in node])
+        raise TypeError(f"batch leaf {type(node).__name__} is not shm-mappable")
+
+    spec = walk(template)
+    return spec, max(offset, _ALIGN)
+
+
+def slot_views(spec, buf, base: int):
+    """Rebuild the batch dict as numpy views into ``buf`` at ``base``."""
+    kind = spec[0]
+    if kind == "leaf":
+        _, shape, dtype_str, off = spec
+        return np.ndarray(shape, dtype=np.dtype(dtype_str), buffer=buf, offset=base + off)
+    if kind == "dict":
+        return {k: slot_views(v, buf, base) for k, v in spec[1].items()}
+    _, is_tuple, items = spec
+    seq = [slot_views(s, buf, base) for s in items]
+    return tuple(seq) if is_tuple else seq
+
+
+def _drain_feed(feed_q, store: EpisodeStore) -> None:
+    while True:
+        try:
+            blob = feed_q.get_nowait()
+        except thqueue.Empty:
+            return
+        try:
+            store.extend([codec.loads(blob)])
+        except Exception:
+            traceback.print_exc()
+
+
+def _batcher_main(shm_name, spec, slot_bytes, args, local_batch, seed,
+                  feed_q, free_q, ready_q, stop) -> None:
+    """Child entry point: replica store -> sample -> fill shm slot.
+
+    Runs under fork (Linux default) or spawn; everything it needs arrives
+    through its arguments, and fork-inherited module state that could
+    carry a held lock is re-created first.  Never touches jax arrays or
+    the device — pure numpy + zlib + codec, i.e. C code that releases the
+    GIL it no longer shares with the learner anyway."""
+    import random
+
+    from . import replay
+
+    replay.reset_block_cache()
+    random.seed((int(seed) & 0xFFFFFFFF) * 1_000_003 + os.getpid())
+    views_by_slot: Dict[int, Dict[str, Any]] = {}
+    shm = None
+    try:
+        # NOTE: attaching registers the segment with the resource tracker a
+        # second time, but fork/spawn children share the parent's tracker
+        # process, so the name is a set entry — the parent's close() path
+        # unlinks and unregisters exactly once and nothing leaks
+        shm = shared_memory.SharedMemory(name=shm_name)
+        store = EpisodeStore(int(args["maximum_episodes"]))
+        fs = args["forward_steps"]
+        bs = args["burn_in_steps"]
+        cs = args["compress_steps"]
+        while not stop.is_set():
+            _drain_feed(feed_q, store)
+            t0 = time.perf_counter()
+            windows: List[Dict[str, Any]] = []
+            while len(windows) < local_batch:
+                if stop.is_set():
+                    return
+                w = store.sample_window(fs, bs, cs)
+                if w is None:
+                    _drain_feed(feed_q, store)
+                    time.sleep(0.05)
+                    continue
+                windows.append(w)
+            t_sample = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            slot = None
+            while slot is None:
+                try:
+                    slot = free_q.get(timeout=0.2)
+                except thqueue.Empty:
+                    if stop.is_set():
+                        return
+                    _drain_feed(feed_q, store)
+            t_free = time.perf_counter() - t0
+
+            out = views_by_slot.get(slot)
+            if out is None:
+                out = views_by_slot[slot] = slot_views(spec, shm.buf, slot * slot_bytes)
+            t0 = time.perf_counter()
+            fill_batch(windows, args, out)
+            ready_q.put((slot, t_sample, time.perf_counter() - t0, t_free))
+    except Exception:
+        traceback.print_exc()
+        try:
+            ready_q.put(("error", traceback.format_exc(limit=5)))
+        except Exception:
+            pass
+    finally:
+        views_by_slot.clear()
+        if shm is not None:
+            try:
+                import gc
+
+                gc.collect()  # numpy views pin shm.buf; drop them first
+                shm.close()
+            except BufferError:
+                pass  # process exit unmaps regardless
+
+
+class ShmBatchPipeline:
+    """Process batchers writing into a shared-memory slot ring.
+
+    Drop-in for trainer.BatchPipeline: same constructor signature, same
+    ``start()``/``batch()`` surface, plus ``stop()`` (join children +
+    unlink the segment) and ``stats()`` (per-stage cumulative timings).
+    """
+
+    mode = "shm"
+
+    def __init__(self, args: Dict[str, Any], store: EpisodeStore, ctx,
+                 stop_event: Optional[threading.Event] = None):
+        self.args = args
+        self.store = store
+        self.ctx = ctx
+        self.stop_event = stop_event or threading.Event()
+        from ..parallel import local_batch_size
+
+        self._local_batch = local_batch_size(args["batch_size"])
+        self._fused = max(1, args.get("fused_steps", 1))
+        # the fused device-put drains `fused` ready slots before freeing
+        # any; fewer than fused+1 slots would deadlock the ring
+        self._n_slots = max(int(args.get("shm_slots", 6)), self._fused + 2, 2)
+        self._device_queue: thqueue.Queue = thqueue.Queue(
+            maxsize=args.get("prefetch_batches", 2)
+        )
+        # fork shares the already-warm parent image (children need numpy +
+        # this package, not a fresh interpreter); spawn is the portable
+        # fallback and everything passed to the child is picklable
+        method = "fork" if "fork" in mp.get_all_start_methods() else "spawn"
+        self._mp = mp.get_context(method)
+        self._procs: List[Any] = []
+        self._feed_qs: List[Any] = []
+        self._slot_views = None
+        self._shm: Optional[shared_memory.SharedMemory] = None
+        self._mp_stop = None
+        self._started = False
+        self._closed = False
+        self._fallback = None
+        self._lock = threading.Lock()
+        self._stats: Dict[str, float] = {k: 0.0 for k in PIPE_STAT_KEYS}
+        self._stats.update(batches=0.0, device_queue_depth_sum=0.0, gets=0.0)
+        self._pending: deque = deque()
+        self._pending_cv = threading.Condition()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        try:
+            self._start_impl()
+        except Exception:
+            traceback.print_exc()
+            print(
+                "[handyrl_tpu] shared-memory batch pipeline failed to start "
+                "(above); falling back to threaded batchers "
+                "(batch_pipeline: thread)",
+                file=sys.stderr,
+            )
+            self.close()
+            from .trainer import BatchPipeline
+
+            self._fallback = BatchPipeline(self.args, self.store, self.ctx, self.stop_event)
+            self._fallback.start()
+
+    def _sample_template_windows(self):
+        windows = []
+        while len(windows) < self._local_batch:
+            if self.stop_event.is_set():
+                return None
+            w = self.store.sample_window(
+                self.args["forward_steps"],
+                self.args["burn_in_steps"],
+                self.args["compress_steps"],
+            )
+            if w is None:
+                time.sleep(0.2)
+                continue
+            windows.append(w)
+        return windows
+
+    def _start_impl(self) -> None:
+        windows = self._sample_template_windows()
+        if windows is None:
+            return  # shutting down before any episode arrived
+        # one reference batch pins the slot layout (fixed shapes) AND
+        # anchors the parity contract: children produce bit-identical
+        # bytes for the same windows (tests/test_shm_pipeline.py)
+        template = make_batch(windows, self.args)
+        self._spec, self._slot_bytes = slot_spec(template)
+        self._shm = shared_memory.SharedMemory(
+            create=True, size=self._slot_bytes * self._n_slots
+        )
+        atexit.register(self._unlink_quiet)
+        self._free_q = self._mp.Queue()
+        for i in range(self._n_slots):
+            self._free_q.put(i)
+        self._ready_q = self._mp.Queue()
+        self._mp_stop = self._mp.Event()
+        self._slot_views = [
+            slot_views(self._spec, self._shm.buf, i * self._slot_bytes)
+            for i in range(self._n_slots)
+        ]
+        self._spawn_children()
+
+    def _spawn_children(self) -> None:
+        # subscribe BEFORE snapshotting: an episode landing in between is
+        # delivered twice (snapshot + listener) rather than lost — a
+        # duplicate in a replica store only nudges sampling weights, a
+        # missing one is a hole in the children's data forever
+        self.store.subscribe(self._on_episodes)
+        snapshot = [codec.dumps(ep) for ep in self.store.snapshot()]
+        for i in range(max(1, int(self.args["num_batchers"]))):
+            feed_q = self._mp.Queue()
+            for blob in snapshot:
+                feed_q.put(blob)
+            self._feed_qs.append(feed_q)
+            proc = self._mp.Process(
+                target=_batcher_main,
+                args=(self._shm.name, self._spec, self._slot_bytes, self.args,
+                      self._local_batch, int(self.args.get("seed", 0)) + i,
+                      feed_q, self._free_q, self._ready_q, self._mp_stop),
+                daemon=True,
+            )
+            import warnings
+
+            with warnings.catch_warnings():
+                # jax warns that fork + its internal threads can deadlock;
+                # these children never call into jax/XLA (pure numpy +
+                # zlib + codec, and replay.reset_block_cache() re-creates
+                # the one inherited lock they touch), so the general
+                # warning does not apply to this fork
+                warnings.filterwarnings(
+                    "ignore", message="os.fork", category=RuntimeWarning
+                )
+                proc.start()
+            self._procs.append(proc)
+        threading.Thread(target=self._feeder_loop, daemon=True).start()
+        threading.Thread(target=self._device_put_loop, daemon=True).start()
+
+    def _on_episodes(self, episodes: List[Dict[str, Any]]) -> None:
+        # store.extend runs on the learner's server thread — only queue a
+        # reference here; the feeder thread pays for encoding
+        with self._pending_cv:
+            self._pending.extend(episodes)
+            self._pending_cv.notify()
+
+    def _feeder_loop(self) -> None:
+        try:
+            while not self.stop_event.is_set():
+                with self._pending_cv:
+                    if not self._pending:
+                        self._pending_cv.wait(timeout=0.3)
+                    batch = list(self._pending)
+                    self._pending.clear()
+                for episode in batch:
+                    blob = codec.dumps(episode)
+                    for feed_q in self._feed_qs:
+                        feed_q.put(blob)
+        except Exception:
+            traceback.print_exc()
+
+    # -- consumer side -------------------------------------------------------
+
+    def _ready_get(self):
+        t0 = time.perf_counter()
+        while not self.stop_event.is_set():
+            try:
+                item = self._ready_q.get(timeout=0.3)
+            except thqueue.Empty:
+                continue
+            if item and item[0] == "error":
+                # a dead silent pipeline deadlocks the trainer — fail loudly
+                print(
+                    "[handyrl_tpu] batcher process died:\n" + str(item[1]),
+                    file=sys.stderr,
+                )
+                self.stop_event.set()
+                return None
+            with self._lock:
+                self._stats["ready_wait_s"] += time.perf_counter() - t0
+            return item
+        return None
+
+    def _device_put_loop(self) -> None:
+        import jax
+
+        try:
+            while not self.stop_event.is_set():
+                group, slots = [], []
+                while len(group) < self._fused:
+                    item = self._ready_get()
+                    if item is None:
+                        return
+                    slot, t_sample, t_assemble, t_free = item
+                    with self._lock:
+                        self._stats["sample_s"] += t_sample
+                        self._stats["assemble_s"] += t_assemble
+                        self._stats["free_wait_s"] += t_free
+                    group.append(self._slot_views[slot])
+                    slots.append(slot)
+                t0 = time.perf_counter()
+                if self._fused > 1:
+                    device_batch = self.ctx.put_batches(group)
+                else:
+                    device_batch = self.ctx.put_batch(group[0])
+                with self._lock:
+                    self._stats["put_s"] += time.perf_counter() - t0
+                    self._stats["batches"] += len(group)
+                # hand the (possibly still-transferring) batch to the
+                # trainer FIRST — its async train-step dispatch overlaps
+                # the rest of the H2D copy...
+                queued = self._put_device(device_batch)
+                # ...but the slots recycle only after the transfer has
+                # finished reading them: an in-flight DMA must never see a
+                # half-overwritten slot
+                t0 = time.perf_counter()
+                jax.block_until_ready(device_batch)
+                with self._lock:
+                    self._stats["put_s"] += time.perf_counter() - t0
+                for slot in slots:
+                    self._free_q.put(slot)
+                if not queued:
+                    return
+        except Exception:
+            traceback.print_exc()
+            self.stop_event.set()
+        finally:
+            self.close()
+
+    def _put_device(self, item) -> bool:
+        while not self.stop_event.is_set():
+            try:
+                self._device_queue.put(item, timeout=0.3)
+                return True
+            except thqueue.Full:
+                continue
+        return False
+
+    def batch(self):
+        """Next device batch, or None when shutting down."""
+        if self._fallback is not None:
+            return self._fallback.batch()
+        with self._lock:
+            self._stats["device_queue_depth_sum"] += self._device_queue.qsize()
+            self._stats["gets"] += 1
+        while not self.stop_event.is_set():
+            try:
+                return self._device_queue.get(timeout=0.3)
+            except thqueue.Empty:
+                continue
+        return None
+
+    # -- teardown / introspection -------------------------------------------
+
+    def stop(self) -> None:
+        self.stop_event.set()
+        if self._fallback is not None:
+            return
+        self.close()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            # a dead pipeline must stop mirroring the episode stream (its
+            # feeder thread is gone; the pending deque would only grow)
+            self.store.unsubscribe(self._on_episodes)
+        except Exception:
+            pass
+        if self._mp_stop is not None:
+            self._mp_stop.set()
+        for proc in self._procs:
+            proc.join(timeout=5.0)
+        for proc in self._procs:
+            if proc.is_alive():
+                proc.terminate()
+                proc.join(timeout=2.0)
+        for q in self._feed_qs + [getattr(self, "_free_q", None),
+                                  getattr(self, "_ready_q", None)]:
+            if q is None:
+                continue
+            try:
+                q.cancel_join_thread()
+                q.close()
+            except Exception:
+                pass
+        self._slot_views = None
+        if self._shm is not None:
+            import gc
+
+            gc.collect()  # release numpy views of shm.buf before unmapping
+            try:
+                self._shm.close()
+            except BufferError:
+                pass
+            self._unlink_quiet()
+        # the atexit safety net is only for pipelines that never reached
+        # close(); keeping it would pin this instance (ctx/store/spec) for
+        # process lifetime — bench runs build several pipelines per process
+        try:
+            atexit.unregister(self._unlink_quiet)
+        except Exception:
+            pass
+
+    def _unlink_quiet(self) -> None:
+        shm = self._shm
+        if shm is None:
+            return
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        except Exception:
+            pass
+
+    def stats(self) -> Dict[str, Any]:
+        if self._fallback is not None:
+            return self._fallback.stats()
+        with self._lock:
+            out = dict(self._stats)
+        out["mode"] = self.mode
+        return out

@@ -2,14 +2,23 @@
 
 Process topology vs the reference (train.py:271-401): the reference forks
 ``num_batchers`` processes for make_batch and trains on the main GPU
-thread.  Here the expensive per-step math is already on the TPU inside one
-jitted call, so the host side is a thread pipeline:
+thread.  The DEFAULT assembly plane here does the same, GIL-free —
+batcher processes writing columnar batches straight into shared-memory
+ring slots (runtime/shm_batch.py, ``batch_pipeline: shm``).  The threaded
+pipeline below (``batch_pipeline: thread``) is kept as the portable
+fallback and the in-process reference implementation:
 
     batcher threads (sample windows + columnar make_batch, numpy)
       -> host batch queue
       -> device-put thread (sharded transfer, double-buffered)
       -> device batch queue
       -> Trainer.train() loop calling the compiled train step
+
+Both pipelines expose per-stage cumulative timings through ``stats()``
+(sample / assemble / free-slot or host-queue wait / ready wait / device
+put / device-queue depth); the trainer diffs them per epoch into
+``pipe_*`` keys in metrics.jsonl so a nonzero ``input_wait_frac`` can be
+attributed to a specific stage.
 
 Epoch handoff keeps the reference semantics (train.py:343-346, 390-401):
 ``update()`` flips a flag and blocks on a 1-slot queue for the snapshot;
@@ -33,8 +42,43 @@ from .batch import make_batch
 from .replay import EpisodeStore
 
 
+# the one canonical stage-key list: every consumer (both pipeline
+# classes, the per-epoch metrics diff below, bench.py's stage report)
+# imports THIS tuple, so adding a stage cannot silently miss a site
+PIPE_STAT_KEYS = ("sample_s", "assemble_s", "free_wait_s", "ready_wait_s", "put_s")
+
+
+def make_pipeline(args: Dict[str, Any], store: EpisodeStore, ctx: TrainContext,
+                  stop_event: Optional[threading.Event] = None):
+    """Build the configured batch-assembly pipeline.
+
+    ``batch_pipeline: shm`` (the default) with ``num_batchers > 0`` forks
+    GIL-free batcher processes writing into shared memory
+    (runtime/shm_batch.py); ``thread`` — or num_batchers 0, or any
+    platform where the shm plane cannot come up — uses the in-process
+    threaded pipeline.  Both expose start()/batch()/stop()/stats()."""
+    mode = args.get("batch_pipeline", "shm")
+    if mode == "shm" and int(args.get("num_batchers", 0)) > 0:
+        try:
+            from .shm_batch import ShmBatchPipeline
+
+            return ShmBatchPipeline(args, store, ctx, stop_event)
+        except Exception:
+            import sys
+
+            traceback.print_exc()
+            print(
+                "[handyrl_tpu] shared-memory batch pipeline unavailable "
+                "(above); using threaded batchers",
+                file=sys.stderr,
+            )
+    return BatchPipeline(args, store, ctx, stop_event)
+
+
 class BatchPipeline:
     """Threaded replay -> numpy batch -> sharded device batch pipeline."""
+
+    mode = "thread"
 
     def __init__(self, args: Dict[str, Any], store: EpisodeStore, ctx: TrainContext, stop_event: Optional[threading.Event] = None):
         self.args = args
@@ -44,6 +88,9 @@ class BatchPipeline:
         self._host_queue: queue.Queue = queue.Queue(maxsize=max(2, args["num_batchers"]))
         self._device_queue: queue.Queue = queue.Queue(maxsize=args.get("prefetch_batches", 2))
         self._started = False
+        self._stats_lock = threading.Lock()
+        self._stats: Dict[str, float] = {k: 0.0 for k in PIPE_STAT_KEYS}
+        self._stats.update(batches=0.0, device_queue_depth_sum=0.0, gets=0.0)
         # under jax.distributed each process assembles its local shard of
         # the global batch (TrainContext.put_batch builds the global array)
         from ..parallel import local_batch_size
@@ -91,17 +138,38 @@ class BatchPipeline:
                 continue
         return None
 
+    def _bump(self, key: str, value: float) -> None:
+        with self._stats_lock:
+            self._stats[key] += value
+
     def _assemble_loop(self):
         try:
             while not self.stop_event.is_set():
+                t0 = time.perf_counter()
                 windows = self._sample_windows()
                 if windows is None:
                     return
-                self._put(self._host_queue, make_batch(windows, self.args))
+                t1 = time.perf_counter()
+                batch = make_batch(windows, self.args)
+                t2 = time.perf_counter()
+                self._put(self._host_queue, batch)
+                t3 = time.perf_counter()
+                with self._stats_lock:
+                    self._stats["sample_s"] += t1 - t0
+                    self._stats["assemble_s"] += t2 - t1
+                    # host-queue full = consumer-bound, the thread analogue
+                    # of waiting for a free shm slot
+                    self._stats["free_wait_s"] += t3 - t2
         except Exception:
             # a dead silent pipeline deadlocks the trainer — fail loudly
             traceback.print_exc()
             self.stop_event.set()
+
+    def _host_get_timed(self):
+        t0 = time.perf_counter()
+        batch = self._get(self._host_queue)
+        self._bump("ready_wait_s", time.perf_counter() - t0)
+        return batch
 
     def _device_put_loop(self):
         try:
@@ -110,23 +178,42 @@ class BatchPipeline:
                 if fused > 1:
                     group = []
                     while len(group) < fused:
-                        batch = self._get(self._host_queue)
+                        batch = self._host_get_timed()
                         if batch is None:  # stop_event or shutdown sentinel
                             return
                         group.append(batch)
-                    self._put(self._device_queue, self.ctx.put_batches(group))
+                    t0 = time.perf_counter()
+                    device_batch = self.ctx.put_batches(group)
                 else:
-                    batch = self._get(self._host_queue)
+                    batch = self._host_get_timed()
                     if batch is None:
                         return
-                    self._put(self._device_queue, self.ctx.put_batch(batch))
+                    group = [batch]
+                    t0 = time.perf_counter()
+                    device_batch = self.ctx.put_batch(batch)
+                with self._stats_lock:
+                    self._stats["put_s"] += time.perf_counter() - t0
+                    self._stats["batches"] += len(group)
+                self._put(self._device_queue, device_batch)
         except Exception:
             traceback.print_exc()
             self.stop_event.set()
 
     def batch(self):
         """Next device batch, or None when shutting down."""
+        with self._stats_lock:
+            self._stats["device_queue_depth_sum"] += self._device_queue.qsize()
+            self._stats["gets"] += 1
         return self._get(self._device_queue)
+
+    def stop(self):
+        self.stop_event.set()
+
+    def stats(self) -> Dict[str, float]:
+        with self._stats_lock:
+            out = dict(self._stats)
+        out["mode"] = self.mode
+        return out
 
 
 class Trainer:
@@ -161,9 +248,10 @@ class Trainer:
             self.fused = 1
         # the pipeline groups k host batches per device call iff the
         # trainer will actually run the fused path — same clamped value
-        self.batcher = BatchPipeline(
+        self.batcher = make_pipeline(
             dict(args, fused_steps=self.fused), self.store, self.ctx, self.stop_event
         )
+        self._pipe_stats0: Dict[str, float] = {}
 
         # device-resident replay (runtime/device_replay.py): set by the
         # Learner before run() when train_args.device_replay is true; the
@@ -318,6 +406,23 @@ class Trainer:
             "train_steps_per_sec": batch_cnt / elapsed,
             "input_wait_frac": wait_s / elapsed,
         }
+        if self.device_replay is None:
+            # per-epoch pipeline stage breakdown (cumulative counters
+            # diffed against the previous epoch's snapshot) — attributes
+            # any input_wait_frac to sample / assemble / queueing / put
+            cur = self.batcher.stats()
+            prev = self._pipe_stats0
+            for key in PIPE_STAT_KEYS:
+                self.stats["pipe_" + key] = round(
+                    cur.get(key, 0.0) - prev.get(key, 0.0), 4
+                )
+            gets = cur.get("gets", 0.0) - prev.get("gets", 0.0)
+            if gets > 0:
+                self.stats["pipe_device_queue_depth"] = round(
+                    (cur.get("device_queue_depth_sum", 0.0)
+                     - prev.get("device_queue_depth_sum", 0.0)) / gets, 3
+                )
+            self._pipe_stats0 = cur
         from ..parallel.train_step import peak_flops_per_chip
 
         peak = peak_flops_per_chip(jax.devices()[0])
@@ -379,6 +484,9 @@ class Trainer:
 
     def stop(self):
         self.stop_event.set()
+        # process batchers need an explicit join + shm unlink; the
+        # threaded pipeline's stop() is just the event set again
+        self.batcher.stop()
 
     def run(self):
         print("waiting training")

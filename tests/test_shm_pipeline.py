@@ -1,0 +1,311 @@
+"""Shared-memory process-batcher pipeline + C columnar-fill parity tests.
+
+The contract under test (ISSUE 1 acceptance): batches produced by the
+GIL-free assembly plane — batcher processes filling shared-memory ring
+slots, with the C fill kernels — are BIT-IDENTICAL to the in-thread numpy
+``make_batch`` reference, on CPU, and the plane shuts down cleanly (no
+orphaned processes, no leaked shm segments).
+
+Every test here is fast (seconds) and CPU-only; the CI workflow runs this
+module standalone as the process-batcher smoke path (``-m pipeline``).
+"""
+
+import random
+import threading
+import time
+from multiprocessing import shared_memory
+
+import jax
+import numpy as np
+import pytest
+
+from handyrl_tpu.config import normalize_args
+from handyrl_tpu.envs import make_env
+from handyrl_tpu.models import InferenceModel, init_variables
+from handyrl_tpu.runtime import batch as batch_mod
+from handyrl_tpu.runtime.batch import fill_batch, make_batch
+from handyrl_tpu.runtime.generation import Generator
+from handyrl_tpu.runtime.replay import EpisodeStore
+from handyrl_tpu.runtime.shm_batch import ShmBatchPipeline, slot_spec, slot_views
+from handyrl_tpu.runtime.trainer import BatchPipeline, make_pipeline
+
+pytestmark = pytest.mark.pipeline
+
+
+def _targs(env="TicTacToe", **over):
+    raw = {"env_args": {"env": env}, "train_args": over}
+    return normalize_args(raw)["train_args"]
+
+
+def _gen_store(env_name, n, targs, seed=0):
+    random.seed(seed)
+    env = make_env({"env": env_name})
+    module = env.net()
+    model = InferenceModel(module, init_variables(module, env, seed=seed))
+    gen = Generator(env, targs)
+    models = {p: model for p in env.players()}
+    gen_args = {"player": env.players(), "model_id": {p: 1 for p in env.players()}}
+    store = EpisodeStore(1000)
+    eps = []
+    while len(eps) < n:
+        ep = gen.generate(models, gen_args)
+        if ep is not None:
+            eps.append(ep)
+    store.extend(eps)
+    return store, eps
+
+
+def _assert_batches_identical(ref, got):
+    assert set(ref) == set(got)
+    for key in ref:
+        ref_leaves = jax.tree.leaves(ref[key])
+        got_leaves = jax.tree.leaves(got[key])
+        assert len(ref_leaves) == len(got_leaves), key
+        for rl, gl in zip(ref_leaves, got_leaves):
+            assert rl.dtype == gl.dtype, key
+            assert rl.shape == gl.shape, key
+            assert rl.tobytes() == gl.tobytes(), f"{key}: bytes differ"
+
+
+class _HostCtx:
+    """put_batch stub: deep-copies, so recycled slots can never alias the
+    'device' batch (mirrors what a real H2D transfer guarantees)."""
+
+    def put_batch(self, batch):
+        return jax.tree.map(np.array, batch)
+
+    def put_batches(self, batches):
+        return [jax.tree.map(np.array, b) for b in batches]
+
+
+# -- C fill kernels vs numpy reference --------------------------------------
+
+
+def test_c_fill_path_bit_identical_to_numpy():
+    """Same windows through the C fill kernels and the pure-numpy fill
+    must produce byte-for-byte identical batches (turn-based gather)."""
+    targs = _targs(batch_size=8, forward_steps=8, burn_in_steps=2)
+    store, _ = _gen_store("TicTacToe", 10, targs)
+    windows = [store.sample_window(8, 2, 4) for _ in range(8)]
+    if batch_mod._ACCEL is None:
+        pytest.skip("C accelerator unavailable (no compiler?)")
+    accel = batch_mod._ACCEL
+    try:
+        got = make_batch(windows, targs)
+        batch_mod._ACCEL = None
+        ref = make_batch(windows, targs)
+    finally:
+        batch_mod._ACCEL = accel
+    _assert_batches_identical(ref, got)
+
+
+def test_c_fill_path_bit_identical_simultaneous_env():
+    """Simultaneous-move path (HungryGeese: 4 players/step, big obs)."""
+    targs = _targs("HungryGeese", batch_size=4, forward_steps=8)
+    store, _ = _gen_store("HungryGeese", 4, targs)
+    windows = [store.sample_window(8, 0, 4) for _ in range(4)]
+    if batch_mod._ACCEL is None:
+        pytest.skip("C accelerator unavailable (no compiler?)")
+    accel = batch_mod._ACCEL
+    try:
+        got = make_batch(windows, targs)
+        batch_mod._ACCEL = None
+        ref = make_batch(windows, targs)
+    finally:
+        batch_mod._ACCEL = accel
+    _assert_batches_identical(ref, got)
+
+
+def test_fill_kernels_validate_bounds():
+    if batch_mod._ACCEL is None:
+        pytest.skip("C accelerator unavailable (no compiler?)")
+    acc = batch_mod._ACCEL
+    dst = np.zeros((2, 4, 3), np.float32)
+    src = np.ones((3, 3), np.float32)
+    with pytest.raises(ValueError):
+        acc.fill_column(dst, [0, 0, 0], [src, src, src])  # more windows than B
+    with pytest.raises(ValueError):
+        acc.fill_column(dst, [0, 2], [src, src])  # second window overruns T
+    with pytest.raises(ValueError):
+        acc.fill_column(dst, [0], [np.ones((3, 4), np.float32)])  # row shape
+    with pytest.raises(ValueError):
+        acc.fill_rows(dst, 0, 0, 5, np.ones((3,), np.float32))  # hi > T
+    with pytest.raises(ValueError):
+        acc.fill_rows(dst, 2, 0, 4, np.ones((3,), np.float32))  # b out of range
+    # and valid calls round-trip
+    acc.fill_column(dst, [1, 0], [src[:2], src])
+    assert np.array_equal(dst[0, 1:3], src[:2])
+    assert np.array_equal(dst[1, 0:3], src)
+    row = np.full((3,), 7.0, np.float32)
+    acc.fill_rows(dst, 0, 3, 4, row)
+    assert np.array_equal(dst[0, 3], row)
+
+
+# -- shared-memory slot fill -------------------------------------------------
+
+
+def test_fill_batch_into_dirty_shm_slot_bit_identical():
+    """fill_batch into a reused (garbage-filled) shm slot must equal the
+    freshly allocated make_batch reference — proves the per-slot reset
+    restores every padding default."""
+    targs = _targs(batch_size=6, forward_steps=8)
+    store, _ = _gen_store("TicTacToe", 8, targs)
+    windows = [store.sample_window(8, 0, 4) for _ in range(6)]
+    ref = make_batch(windows, targs)
+    spec, slot_bytes = slot_spec(ref)
+    shm = shared_memory.SharedMemory(create=True, size=slot_bytes)
+    try:
+        views = slot_views(spec, shm.buf, 0)
+        shm.buf[:slot_bytes] = bytes([0xAB]) * slot_bytes  # dirty slot
+        fill_batch(windows, targs, views)
+        _assert_batches_identical(ref, views)
+        # second fill over its own previous content (the steady state)
+        fill_batch(windows, targs, views)
+        _assert_batches_identical(ref, views)
+    finally:
+        views = None
+        import gc
+
+        gc.collect()
+        shm.close()
+        shm.unlink()
+
+
+# -- the full process pipeline ----------------------------------------------
+
+
+def test_process_batcher_batch_matches_make_batch_bit_identical():
+    """Cross-process parity: with ONE short episode and forward_steps >
+    episode length, window sampling is deterministic (train_start 0, whole
+    episode), so a batch assembled by a batcher process in shared memory
+    must be bit-identical to make_batch in this process."""
+    targs = _targs(batch_size=2, forward_steps=16, num_batchers=1)
+    store, eps = _gen_store("TicTacToe", 1, targs)
+    assert eps[0]["steps"] <= 16
+    windows = [store.sample_window(16, 0, 4) for _ in range(2)]
+    ref = make_batch(windows, targs)
+
+    stop = threading.Event()
+    pipe = ShmBatchPipeline(targs, store, _HostCtx(), stop)
+    pipe.start()
+    try:
+        assert pipe._fallback is None, "shm plane fell back to threads"
+        got = pipe.batch()
+        assert got is not None
+        _assert_batches_identical(ref, got)
+    finally:
+        stop.set()
+        pipe.stop()
+
+
+def test_process_pipeline_produces_and_cleans_up():
+    targs = _targs(batch_size=4, forward_steps=8, num_batchers=2)
+    store, eps = _gen_store("TicTacToe", 8, targs)
+    stop = threading.Event()
+    pipe = ShmBatchPipeline(targs, store, _HostCtx(), stop)
+    pipe.start()
+    assert pipe._fallback is None
+    shm_name = pipe._shm.name
+    ref_shape = make_batch([store.sample_window(8, 0, 4) for _ in range(4)], targs)
+    for _ in range(3):
+        got = pipe.batch()
+        assert got is not None
+        assert got["observation"].shape == ref_shape["observation"].shape
+        assert got["action"].dtype == np.int32
+        assert float(got["episode_mask"].sum()) > 0
+    # live episode feed must not disturb the stream
+    store.extend(eps[:2])
+    assert pipe.batch() is not None
+    stats = pipe.stats()
+    assert stats["mode"] == "shm"
+    assert stats["batches"] >= 4
+    assert stats["assemble_s"] > 0
+    pipe.stop()
+    for proc in pipe._procs:
+        assert not proc.is_alive(), "orphaned batcher process"
+    with pytest.raises(FileNotFoundError):
+        shared_memory.SharedMemory(name=shm_name)
+
+
+def test_stop_event_alone_reaps_processes_and_shm():
+    """Trainer-style shutdown: ONLY the shared stop_event is set; the
+    pipeline's own threads must join the children and unlink the segment
+    (the no-orphaned-shm acceptance criterion)."""
+    targs = _targs(batch_size=4, forward_steps=8, num_batchers=2)
+    store, _ = _gen_store("TicTacToe", 6, targs)
+    stop = threading.Event()
+    pipe = ShmBatchPipeline(targs, store, _HostCtx(), stop)
+    pipe.start()
+    assert pipe._fallback is None
+    shm_name = pipe._shm.name
+    assert pipe.batch() is not None
+    stop.set()
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            probe = shared_memory.SharedMemory(name=shm_name)
+            probe.close()
+            time.sleep(0.2)
+        except FileNotFoundError:
+            break
+    else:
+        pytest.fail("shm segment still linked 15s after stop_event")
+    for proc in pipe._procs:
+        proc.join(timeout=5)
+        assert not proc.is_alive()
+
+
+def test_fused_grouping_through_shm_pipeline():
+    targs = _targs(batch_size=4, forward_steps=8, num_batchers=1, fused_steps=2)
+    store, _ = _gen_store("TicTacToe", 6, targs)
+    stop = threading.Event()
+    pipe = ShmBatchPipeline(targs, store, _HostCtx(), stop)
+    pipe.start()
+    try:
+        assert pipe._fallback is None
+        group = pipe.batch()
+        assert isinstance(group, list) and len(group) == 2
+    finally:
+        stop.set()
+        pipe.stop()
+
+
+# -- factory + config wiring -------------------------------------------------
+
+
+def test_make_pipeline_mode_selection():
+    targs = _targs(batch_size=4, forward_steps=8, num_batchers=1)
+    store = EpisodeStore(10)
+    ctx = _HostCtx()
+    assert isinstance(make_pipeline(targs, store, ctx), ShmBatchPipeline)
+    thread_args = dict(targs, batch_pipeline="thread")
+    assert isinstance(make_pipeline(thread_args, store, ctx), BatchPipeline)
+    no_batchers = dict(targs, num_batchers=0)
+    assert isinstance(make_pipeline(no_batchers, store, ctx), BatchPipeline)
+
+
+def test_config_validates_pipeline_knobs():
+    with pytest.raises(ValueError):
+        _targs(batch_pipeline="fiber")
+    with pytest.raises(ValueError):
+        _targs(shm_slots=1)
+    assert _targs()["batch_pipeline"] == "shm"
+
+
+def test_thread_pipeline_reports_stage_stats():
+    targs = _targs(batch_size=4, forward_steps=8, num_batchers=1,
+                   batch_pipeline="thread")
+    store, _ = _gen_store("TicTacToe", 6, targs)
+    stop = threading.Event()
+    pipe = BatchPipeline(targs, store, _HostCtx(), stop)
+    pipe.start()
+    try:
+        assert pipe.batch() is not None
+        stats = pipe.stats()
+        assert stats["mode"] == "thread"
+        assert stats["batches"] >= 1
+        for key in ("sample_s", "assemble_s", "free_wait_s", "ready_wait_s", "put_s"):
+            assert key in stats
+    finally:
+        stop.set()
+        pipe.stop()

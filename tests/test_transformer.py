@@ -86,14 +86,15 @@ def test_stateful_model_without_observation_fails_fast():
 
 def test_bench_tpu_transformer_config_traces():
     """Abstractly evaluate the EXACT train program the bench's TPU-gated
-    transformer stage compiles on-chip (d1024/L8/H16, B64, T64, bf16,
-    einsum attention — the measured winner at this short window; the
+    transformer stage compiles on-chip (whatever shape
+    bench.TRANSFORMER_TPU_NET_ARGS currently pins — d1536/L8/H16, B64,
+    T64, bf16, einsum attention as of the 2026-08-02 width sweep; the
     flash path's kernel shapes are covered by the battery in
     tests/test_flash_attention.py).  The stage never executes in CI, so without
     this trace a shape bug in the big config would first surface
     mid-capture on a live chip lease.  eval_shape runs the full trace —
     forward, attention, losses, grads, Adam — without lowering or
-    allocating the 134M-param state."""
+    allocating the big-net state."""
     import sys
     from pathlib import Path
 
@@ -115,7 +116,13 @@ def test_bench_tpu_transformer_config_traces():
     args["env"] = cfg["env_args"]
     env = make_env(args["env"])
     module = env.net()
-    assert (module.d_model, module.n_layers) == (1024, 8)
+    # derived from the bench pin, not hard-coded: the whole point of this
+    # guard is to trace whatever the chip-gated stage will actually
+    # compile, so a re-pinned width must never desynchronize it again
+    assert (module.d_model, module.n_layers) == (
+        bench.TRANSFORMER_TPU_NET_ARGS["d_model"],
+        bench.TRANSFORMER_TPU_NET_ARGS["n_layers"],
+    )
 
     # abstract params/opt state: no 134M-param allocation
     env.reset()
