@@ -36,13 +36,46 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     "epochs": -1,
     "num_batchers": 2,
     "eval_rate": 0.1,
-    "worker": {"num_parallel": 6, "entry_port": 9999, "data_port": 9998},
+    "worker": {
+        "num_parallel": 6,
+        "entry_port": 9999,
+        "data_port": 9998,
+        # liveness ping cadence on the remote actor plane, both directions
+        # (server -> gathers from a dedicated thread, gathers -> server);
+        # a peer silent for ~3 intervals is presumed dead.  0 disables
+        # heartbeats AND the silence deadline (pre-fault-tolerance wire
+        # behavior, for debugging only)
+        "heartbeat_interval": 10.0,
+        # max stall (no byte of progress) on gather RPC send/receive: a
+        # WAN blackhole surfaces as TimeoutError -> teardown -> rejoin,
+        # never a hang, while a big params blob trickling over a slow
+        # link stays alive as long as bytes flow
+        "socket_timeout": 60.0,
+        # entry-handshake deadline: a client that connects and stalls is
+        # dropped so the single entry thread keeps serving later joins
+        "entry_timeout": 10.0,
+    },
     "lambda": 0.7,
     "policy_target": "TD",
     "value_target": "TD",
     "eval": {"opponent": ["random"]},
     "seed": 0,
+    # 0 = fresh start; N > 0 = resume from models/{N}.ckpt (digest-checked
+    # against models/MANIFEST.json, refusing corrupt files); -1 = AUTO:
+    # resume from the newest manifest entry that verifies, falling back to
+    # older verified snapshots — the knob a preemptible-TPU launcher sets
+    # once and never touches again
     "restart_epoch": 0,
+    # epoch snapshots ({N}.ckpt) kept on disk; older ones are GC'd at each
+    # save (latest.ckpt / state.ckpt always survive).  0 = keep all
+    "keep_checkpoints": 100,
+    # shm batcher supervision (runtime/shm_batch.py): respawn a dead
+    # batcher child up to this many times, then degrade loudly to the
+    # threaded pipeline; also degrade if the ring moves nothing for
+    # batcher_stall_timeout seconds after a death (a SIGKILL can take a
+    # multiprocessing queue lock with it)
+    "batcher_max_restarts": 3,
+    "batcher_stall_timeout": 60.0,
     # --- TPU-native additions -------------------------------------------
     "mesh": {"dp": -1},
     # multi-host learner plane (parallel/distributed.py): set
@@ -119,6 +152,15 @@ DEFAULT_WORKER_ARGS: Dict[str, Any] = {
     "server_address": "",
     "num_parallel": 8,
     "entry_port": 9999,
+    # on a severed/stalled connection the worker machine tears its session
+    # down (no actor thread survives) and re-enters through the entry port
+    # with exponential backoff; rejoin: false restores join-once behavior
+    "rejoin": True,
+    "rejoin_backoff": 1.0,
+    "rejoin_backoff_max": 60.0,
+    # bound on consecutive failed sessions before giving up (-1 = forever,
+    # the right default for a fleet behind a supervisor)
+    "max_rejoins": -1,
 }
 
 VALID_TARGETS = ("MC", "TD", "UPGO", "VTRACE")
@@ -144,6 +186,22 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
             raise ValueError(f"train_args.{key} must be positive, got {train[key]}")
     if train["burn_in_steps"] < 0:
         raise ValueError("train_args.burn_in_steps must be >= 0")
+    if train["restart_epoch"] < -1:
+        raise ValueError(
+            "train_args.restart_epoch must be >= -1 (-1 = auto-resume from "
+            "the newest verified snapshot)"
+        )
+    if train["keep_checkpoints"] < 0:
+        raise ValueError("train_args.keep_checkpoints must be >= 0 (0 = keep all)")
+    if train["batcher_max_restarts"] < 0:
+        raise ValueError("train_args.batcher_max_restarts must be >= 0")
+    if train["batcher_stall_timeout"] <= 0:
+        raise ValueError("train_args.batcher_stall_timeout must be > 0")
+    if train["worker"]["heartbeat_interval"] < 0:
+        raise ValueError("train_args.worker.heartbeat_interval must be >= 0 (0 = off)")
+    for key in ("socket_timeout", "entry_timeout"):
+        if train["worker"][key] <= 0:
+            raise ValueError(f"train_args.worker.{key} must be > 0")
     if train["fused_steps"] < 1:
         raise ValueError("train_args.fused_steps must be >= 1")
     if train["batch_pipeline"] not in ("shm", "thread"):

@@ -52,6 +52,29 @@ def _so_path() -> Path:
     return _cache_dir() / f"_codec_accel.{tag}.{digest}.so"
 
 
+def _gc_stale(so: Path) -> None:
+    """Remove superseded hash-suffixed builds next to the fresh one.
+
+    Every source edit changes the cache name, so without this the package
+    dir accumulates one dead .so per rebuild forever.  PACKAGE-DIR ONLY:
+    in that dir a different digest can only be a stale build of THIS
+    checkout, while the shared ``~/.cache`` fallback may legitimately hold
+    live builds from other checkouts at other source versions (the very
+    scenario the content-hash cache names exist for) — deleting those
+    would force a from-scratch recompile on every checkout alternation.
+    Only artifacts of the same ABI tag are touched; a concurrently racing
+    builder's tmp files don't match the glob."""
+    if so.parent != _SRC.parent:
+        return
+    prefix = so.name.rsplit(".", 2)[0]  # '_codec_accel.<SOABI>'
+    for stale in so.parent.glob(f"{prefix}.*.so"):
+        if stale != so:
+            try:
+                stale.unlink()
+            except OSError:
+                pass  # another builder already removed it / read-only dir
+
+
 def _compile(so: Path) -> None:
     cc = os.environ.get("CC", "cc")
     include = sysconfig.get_paths()["include"]
@@ -66,6 +89,7 @@ def _compile(so: Path) -> None:
             timeout=120,
         )
         os.replace(tmp, so)  # atomic: racing builders both win
+        _gc_stale(so)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)

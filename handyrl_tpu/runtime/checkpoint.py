@@ -9,22 +9,108 @@ Layout mirrors the reference naming so tooling ports over:
     models/{epoch}.ckpt    per-epoch params snapshot (servable to workers)
     models/latest.ckpt     copy of the newest snapshot
     models/state.ckpt      params + opt_state + steps (resume)
+    models/MANIFEST.json   per-epoch CRC32 digests of the files above
+
+Durability contract (docs/fault_tolerance.md): every write here is
+tmp-file -> fsync -> atomic rename, so a crash mid-save can never corrupt
+an existing resume point — the worst case is a stray ``*.tmp.*`` file.
+The manifest records epoch, step count and a CRC32 + size per file;
+``restart_epoch: -1`` resumes from the newest manifest entry whose
+snapshot still verifies, falling back to older verified entries, and an
+explicitly requested epoch REFUSES to load a file whose digest no longer
+matches (silent corruption must fail loudly, not train on garbage).
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Dict
+import re
+import tempfile
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
 from flax import serialization
 
+MANIFEST_NAME = "MANIFEST.json"
+
+_EPOCH_CKPT_RE = re.compile(r"^(\d+)\.ckpt$")
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file failed digest verification or cannot be trusted."""
+
+
+# ---------------------------------------------------------------------------
+# atomic file plumbing
+# ---------------------------------------------------------------------------
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably record a rename in its directory (best-effort off Linux)."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """tmp file in the target dir -> write -> fsync -> atomic rename.
+
+    A reader can only ever observe the old complete file or the new
+    complete file; a crash at any instant leaves at most a stray tmp file
+    (which resume ignores — only manifest-recorded names are considered).
+    """
+    directory = os.path.dirname(path) or "."
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".tmp.", dir=directory
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(directory)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def file_digest(path: str) -> Tuple[int, int]:
+    """(crc32, size) of a file, streamed (snapshots can be large)."""
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return crc, size
+
+
+# ---------------------------------------------------------------------------
+# serialization (kept signature-compatible with the pre-manifest API; all
+# saves are atomic now)
+# ---------------------------------------------------------------------------
+
 
 def save_params(path: str, params: Any) -> None:
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(serialization.to_bytes(jax.device_get(params)))
+    atomic_write_bytes(path, serialization.to_bytes(jax.device_get(params)))
 
 
 def load_params(path: str, template: Any) -> Any:
@@ -41,10 +127,7 @@ def params_from_bytes(template: Any, blob: bytes) -> Any:
 
 
 def save_train_state(path: str, state: Dict[str, Any]) -> None:
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    host = jax.device_get(state)
-    with open(path, "wb") as f:
-        f.write(serialization.to_bytes(host))
+    atomic_write_bytes(path, serialization.to_bytes(jax.device_get(state)))
 
 
 def load_train_state(path: str, template: Dict[str, Any]) -> Dict[str, Any]:
@@ -58,3 +141,211 @@ def model_path(model_dir: str, epoch: int) -> str:
 
 def latest_model_path(model_dir: str) -> str:
     return os.path.join(model_dir, "latest.ckpt")
+
+
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+
+def load_manifest(model_dir: str, strict: bool = False) -> Dict[str, Any]:
+    """The digest manifest; a MISSING file is an empty manifest (pre-
+    manifest runs must keep loading).
+
+    An UNPARSEABLE file is different: manifest writes are atomic, so
+    invalid JSON means real corruption is present on this disk — with
+    ``strict`` (every verification path) that raises CheckpointError
+    rather than silently disabling all digest checks exactly when they
+    matter most.  Non-strict callers (the save path, GC) start a fresh
+    manifest instead: refusing to record NEW snapshots because an old
+    record rotted would kill a healthy training run, and the rewrite
+    self-heals the file.
+    """
+    path = os.path.join(model_dir, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+        if not isinstance(manifest, dict) or not isinstance(manifest.get("epochs"), dict):
+            raise ValueError("manifest is not an object with an 'epochs' map")
+    except OSError:
+        return {"version": 1, "epochs": {}}
+    except ValueError as exc:
+        if strict:
+            raise CheckpointError(
+                f"{path} is corrupt ({exc}); digest verification is "
+                "impossible — inspect the checkpoint dir (delete the "
+                "manifest to explicitly accept an unverified resume)"
+            )
+        return {"version": 1, "epochs": {}}
+    return manifest
+
+
+def _write_manifest(model_dir: str, manifest: Dict[str, Any]) -> None:
+    atomic_write_bytes(
+        os.path.join(model_dir, MANIFEST_NAME),
+        json.dumps(manifest, indent=1, sort_keys=True).encode(),
+    )
+
+
+def _verify_file(path: str, meta: Dict[str, Any]) -> bool:
+    try:
+        crc, size = file_digest(path)
+    except OSError:
+        return False
+    return crc == int(meta["crc32"]) and size == int(meta["size"])
+
+
+def verify_snapshot(model_dir: str, epoch: int) -> Optional[bool]:
+    """Does ``{epoch}.ckpt`` match its manifest digest?
+
+    None = the manifest has no record of this epoch (pre-manifest file:
+    nothing to verify against); True/False otherwise.
+    """
+    entry = load_manifest(model_dir, strict=True)["epochs"].get(str(int(epoch)))
+    if entry is None:
+        return None
+    meta = entry.get("files", {}).get(f"{int(epoch)}.ckpt")
+    if meta is None:
+        return None
+    return _verify_file(model_path(model_dir, epoch), meta)
+
+
+def verify_state(model_dir: str, epoch: int) -> Optional[bool]:
+    """Does state.ckpt match the digest recorded at ``epoch``?
+
+    state.ckpt is overwritten every epoch, so only the NEWEST manifest
+    entry's digest can match a healthy file; older entries' records are
+    stale by construction and the epoch guard in Trainer.load_state
+    handles that case.  None = no record to verify against.
+    """
+    entry = load_manifest(model_dir, strict=True)["epochs"].get(str(int(epoch)))
+    meta = (entry or {}).get("files", {}).get("state.ckpt")
+    if meta is None:
+        return None
+    return _verify_file(os.path.join(model_dir, "state.ckpt"), meta)
+
+
+def record_snapshot(
+    model_dir: str,
+    epoch: int,
+    steps: int,
+    file_digests: Dict[str, Tuple[int, int]],
+) -> None:
+    """Append one epoch's entry to the manifest (atomically rewritten)."""
+    manifest = load_manifest(model_dir)
+    manifest["epochs"][str(int(epoch))] = {
+        "steps": int(steps),
+        "files": {
+            name: {"crc32": int(crc), "size": int(size)}
+            for name, (crc, size) in file_digests.items()
+        },
+    }
+    _write_manifest(model_dir, manifest)
+
+
+def save_epoch_snapshot(
+    model_dir: str, epoch: int, params: Any, state_payload: Dict[str, Any], steps: int
+) -> None:
+    """One epoch boundary's full durable save: ``{epoch}.ckpt`` +
+    ``latest.ckpt`` + ``state.ckpt``, each tmp->fsync->rename, then the
+    manifest entry with a CRC32 per file.  Params serialize once; the
+    digests come from the in-memory blobs (no read-back)."""
+    params_blob = params_to_bytes(params)
+    state_blob = serialization.to_bytes(jax.device_get(state_payload))
+    atomic_write_bytes(model_path(model_dir, epoch), params_blob)
+    atomic_write_bytes(latest_model_path(model_dir), params_blob)
+    atomic_write_bytes(os.path.join(model_dir, "state.ckpt"), state_blob)
+    params_digest = (zlib.crc32(params_blob), len(params_blob))
+    record_snapshot(
+        model_dir,
+        epoch,
+        steps,
+        {
+            f"{int(epoch)}.ckpt": params_digest,
+            "latest.ckpt": params_digest,
+            "state.ckpt": (zlib.crc32(state_blob), len(state_blob)),
+        },
+    )
+
+
+def latest_verified_epoch(model_dir: str) -> int:
+    """Newest epoch whose snapshot verifies; 0 when none does.
+
+    The auto-resume entry point (``restart_epoch: -1``): corrupt or
+    missing snapshots are skipped, falling back to the next-older verified
+    entry, so a crash mid-write (or a bit-flipped file) costs at most one
+    epoch, never the run.  Pre-manifest run directories (an upgraded
+    long-running job) fall back to the newest on-disk ``{N}.ckpt`` the
+    manifest never recorded — mirroring ``load_verified_params``'s
+    leniency for unrecorded files, so flipping a launcher to ``-1`` can
+    never silently restart an old run from scratch.  Files the manifest
+    DOES record but that fail verification stay refused.
+    """
+    manifest = load_manifest(model_dir, strict=True)
+    recorded = manifest["epochs"]
+    for key in sorted(recorded, key=int, reverse=True):
+        meta = recorded[key].get("files", {}).get(f"{key}.ckpt")
+        if meta is not None and _verify_file(model_path(model_dir, int(key)), meta):
+            return int(key)
+    try:
+        names = os.listdir(model_dir)
+    except OSError:
+        return 0
+    unrecorded = [
+        int(m.group(1))
+        for name in names
+        if (m := _EPOCH_CKPT_RE.match(name)) and str(int(m.group(1))) not in recorded
+    ]
+    return max(unrecorded, default=0)
+
+
+def load_verified_params(
+    model_dir: str, epoch: int, template: Any, pre_verified: bool = False
+) -> Any:
+    """load_params that refuses a digest-mismatched snapshot.
+
+    Files the manifest never recorded (pre-manifest runs) load as before;
+    a recorded file whose bytes no longer match raises CheckpointError —
+    silently training on a corrupt snapshot is the one unrecoverable
+    failure mode.  ``pre_verified`` skips the digest scan when the caller
+    JUST verified this epoch (auto-resume via latest_verified_epoch):
+    multi-GB snapshots should not be streamed twice at startup.
+    """
+    verdict = None if pre_verified else verify_snapshot(model_dir, epoch)
+    if verdict is False:
+        raise CheckpointError(
+            f"{model_path(model_dir, epoch)} does not match its manifest "
+            "digest (truncated or corrupt); refusing to load — use "
+            "restart_epoch: -1 to fall back to the newest verified snapshot"
+        )
+    return load_params(model_path(model_dir, epoch), template)
+
+
+def gc_snapshots(model_dir: str, keep: int) -> List[int]:
+    """Delete epoch snapshots older than the newest ``keep`` (0 = keep
+    all), pruning their manifest entries.  Only ``{N}.ckpt`` files are
+    touched; latest.ckpt / state.ckpt always survive.  Returns the epochs
+    removed."""
+    if keep <= 0:
+        return []
+    try:
+        names = os.listdir(model_dir)
+    except OSError:
+        return []
+    epochs = sorted(
+        int(m.group(1)) for name in names if (m := _EPOCH_CKPT_RE.match(name))
+    )
+    doomed = epochs[:-keep] if len(epochs) > keep else []
+    if not doomed:
+        return []
+    for epoch in doomed:
+        try:
+            os.unlink(model_path(model_dir, epoch))
+        except OSError:
+            pass
+    manifest = load_manifest(model_dir)
+    pruned = {k: v for k, v in manifest["epochs"].items() if int(k) not in set(doomed)}
+    if len(pruned) != len(manifest["epochs"]):
+        manifest["epochs"] = pruned
+        _write_manifest(model_dir, manifest)
+    return doomed

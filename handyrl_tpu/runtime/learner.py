@@ -35,11 +35,11 @@ from ..envs import make_env, prepare_env
 from ..models import init_variables
 from ..parallel import is_coordinator, make_mesh
 from .checkpoint import (
-    latest_model_path,
-    load_params,
-    model_path,
-    save_params,
-    save_train_state,
+    gc_snapshots,
+    latest_verified_epoch,
+    load_verified_params,
+    save_epoch_snapshot,
+    verify_state,
 )
 from .trainer import Trainer
 from .worker import LocalModelServer, LocalWorkerPool
@@ -64,8 +64,48 @@ class Learner:
         params = variables["params"]
 
         self.model_epoch = self.args["restart_epoch"]
+        auto_resumed = False
+        if self.model_epoch < 0:
+            # auto-resume: newest manifest entry whose snapshot digest
+            # still verifies, falling back to older verified epochs when a
+            # crash or bit-rot corrupted the newest one (0 = fresh start)
+            import jax
+
+            if jax.process_count() > 1:
+                # every SPMD process must resume the SAME epoch, and only
+                # the coordinator writes checkpoints — so only IT scans
+                # (the digest sweep can stream many GB; N-1 redundant
+                # sweeps of a shared filesystem would all be discarded)
+                # and broadcasts its verdict.  On a NON-shared model_dir
+                # the other processes then fail LOUDLY below
+                # (load_verified_params can't find the file) instead of
+                # silently feeding fresh seed params into the collective
+                # train step, exactly like an explicit restart_epoch.
+                from jax.experimental import multihost_utils
+
+                import numpy as np
+
+                local = latest_verified_epoch(self.model_dir) if is_coordinator() else 0
+                self.model_epoch = int(
+                    multihost_utils.broadcast_one_to_all(np.int32(local))
+                )
+                # coordinator-verified, not locally verified, off process 0
+                auto_resumed = self.model_epoch > 0 and is_coordinator()
+            else:
+                self.model_epoch = latest_verified_epoch(self.model_dir)
+                auto_resumed = self.model_epoch > 0
+            print(
+                f"auto-resume (restart_epoch: -1): epoch {self.model_epoch}"
+                if self.model_epoch > 0
+                else "auto-resume (restart_epoch: -1): no verified snapshot; fresh start"
+            )
         if self.model_epoch > 0:
-            params = load_params(model_path(self.model_dir, self.model_epoch), params)
+            # refuses a digest-mismatched file: silently training on a
+            # corrupt snapshot is the one unrecoverable failure mode
+            # (pre_verified: auto-resume just digest-scanned this epoch)
+            params = load_verified_params(
+                self.model_dir, self.model_epoch, params, pre_verified=auto_resumed
+            )
 
         # generated datum
         self.generation_results: Dict[int, tuple] = {}
@@ -90,12 +130,20 @@ class Learner:
         )
         if self.model_epoch > 0:
             state_path = os.path.join(self.model_dir, "state.ckpt")
-            if os.path.exists(state_path):
+            if not os.path.exists(state_path):
+                print(f"{state_path} not found; resuming with a fresh optimizer")
+            elif verify_state(self.model_dir, self.model_epoch) is False:
+                # recorded digest mismatch: truncated/corrupt optimizer
+                # state — params are verified above, so branch with a
+                # fresh optimizer instead of deserializing garbage
+                print(
+                    f"{state_path} fails digest verification; "
+                    "resuming with a fresh optimizer"
+                )
+            else:
                 # adopts Adam moments + step count + lr EMA, but only when
                 # the file matches restart_epoch (an earlier epoch = branch)
                 self.trainer.load_state(state_path, self.model_epoch)
-            else:
-                print(f"{state_path} not found; resuming with a fresh optimizer")
         self.model_server = LocalModelServer(self.module, make_env(args["env_args"]), self.args)
         self.model_server.publish(self.model_epoch, params)
 
@@ -355,13 +403,18 @@ class Learner:
         self.model_epoch += 1
         if is_coordinator():
             # process-0 guard: under jax.distributed every process runs the
-            # SPMD train step, but exactly one owns the checkpoint files
-            save_params(model_path(self.model_dir, self.model_epoch), params)
-            save_params(latest_model_path(self.model_dir), params)
-            save_train_state(
-                os.path.join(self.model_dir, "state.ckpt"),
+            # SPMD train step, but exactly one owns the checkpoint files.
+            # Every file goes tmp -> fsync -> rename and lands in the CRC
+            # manifest, so a crash at ANY instant leaves the previous
+            # epoch's resume point intact and verifiable.
+            save_epoch_snapshot(
+                self.model_dir,
+                self.model_epoch,
+                params,
                 self.trainer.save_payload(self.model_epoch),
+                steps,
             )
+            gc_snapshots(self.model_dir, int(self.args.get("keep_checkpoints", 0)))
         self.model_server.publish(self.model_epoch, params)
 
     def _write_metrics(self, record: Dict[str, Any]) -> None:
@@ -452,6 +505,13 @@ class Learner:
                 fut.set_result(None)
             elif req == "result":
                 self.feed_results([data] if not isinstance(data, list) else data)
+                fut.set_result(None)
+            elif req == "jobs_lost":
+                # a worker connection vanished with jobs in flight: hand
+                # their counts back so the generation/evaluation balance
+                # re-dispatches equivalents to the surviving workers
+                self.num_episodes = max(0, self.num_episodes - int(data.get("g", 0)))
+                self.num_results = max(0, self.num_results - int(data.get("e", 0)))
                 fut.set_result(None)
             elif req == "model":
                 fut.set_result(self.model_server.get(data))

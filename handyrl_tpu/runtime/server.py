@@ -17,16 +17,34 @@ TPU-first differences:
   machine's local engine — never pickled module code (SURVEY.md §2.5).
 * A gather prefetches job assignments in bulk and flushes episode/result
   uploads in bulk (worker.py:136-168 semantics) to amortize WAN RTT.
+
+Fault tolerance (docs/fault_tolerance.md):
+
+* The entry handshake has a deadline — a client that connects and then
+  stalls is dropped after ``entry_timeout`` instead of wedging the single
+  entry thread for every later join.
+* Liveness is heartbeat-based in BOTH directions.  The server pings every
+  gather connection each ``heartbeat_interval`` from a dedicated thread
+  (so pings flow even while the learner spends minutes inside an epoch
+  boundary), and drops peers silent for ~3 intervals; gathers ping the
+  server the same way and treat ~3 silent intervals as a dead link.
+* A severed gather connection is not fatal to the worker machine: the
+  cluster tears down its session (no actor thread survives) and re-enters
+  through the entry port with exponential backoff.  The server reclaims
+  the vanished connection's in-flight jobs via ``jobs_lost`` so the
+  learner's generation/evaluation balance re-dispatches them.
 """
 
 from __future__ import annotations
 
+import socket
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ..envs import make_env, prepare_env
 from ..models import InferenceModel, RandomModel, init_variables
-from .checkpoint import load_params, model_path, params_from_bytes, params_to_bytes
+from .checkpoint import load_verified_params, params_from_bytes, params_to_bytes
 from .connection import (
     FramedConnection,
     QueueCommunicator,
@@ -39,6 +57,12 @@ from .worker import Worker
 
 ENTRY_PORT = 9999
 DATA_PORT = 9998
+
+_HB = ("__hb__",)  # liveness ping frame (both directions); never a reply
+
+
+def _is_hb(frame: Any) -> bool:
+    return isinstance(frame, tuple) and len(frame) == 1 and frame[0] == "__hb__"
 
 
 # ---------------------------------------------------------------------------
@@ -57,18 +81,33 @@ class WorkerServer(QueueCommunicator):
     """
 
     def __init__(self, args: Dict[str, Any], handler: Callable, model_server):
-        super().__init__()
+        worker_cfg = args["worker"]
+        self.heartbeat_interval = float(worker_cfg.get("heartbeat_interval", 10.0))
+        super().__init__(
+            recv_timeout=(
+                3.0 * self.heartbeat_interval if self.heartbeat_interval > 0 else None
+            )
+        )
         self.args = args
         self.handler = handler
         self.model_server = model_server
-        self.entry_port = int(args["worker"].get("entry_port", ENTRY_PORT))
-        self.data_port = int(args["worker"].get("data_port", DATA_PORT))
+        self.entry_port = int(worker_cfg.get("entry_port", ENTRY_PORT))
+        self.data_port = int(worker_cfg.get("data_port", DATA_PORT))
+        self.entry_timeout = float(worker_cfg.get("entry_timeout", 10.0))
         self.total_worker_count = 0
         self._threads: List[threading.Thread] = []
         self._blob_cache: Dict[int, bytes] = {}
+        # in-flight job ledger per connection: assignments sent minus
+        # uploads received; a vanished peer's balance is handed back to the
+        # learner as ('jobs_lost', {'g': n, 'e': m}) so it re-dispatches
+        self._inflight: Dict[FramedConnection, Dict[str, int]] = {}
+        self._inflight_lock = threading.Lock()
 
     def run(self) -> None:
-        for target in (self._entry_server, self._data_server, self._dispatch):
+        targets = [self._entry_server, self._data_server, self._dispatch]
+        if self.heartbeat_interval > 0:
+            targets.append(self._heartbeat_loop)
+        for target in targets:
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
@@ -81,7 +120,12 @@ class WorkerServer(QueueCommunicator):
                     break
                 continue
             try:
-                worker_args = conn.recv()
+                # HARD deadline on the single entry thread: a client that
+                # connects and then stalls — or drip-feeds one byte per
+                # gap — must not wedge every later join.  Handshake frames
+                # are tiny, so an absolute budget is the right semantics
+                # here (unlike the data plane's stall-bounded transfers)
+                worker_args = conn.recv(timeout=self.entry_timeout, hard=True)
                 n = int(worker_args.get("num_parallel", 8))
                 reply = {
                     "env_args": self.args["env"],
@@ -89,7 +133,9 @@ class WorkerServer(QueueCommunicator):
                     "worker_args": dict(worker_args, base_worker_id=self.total_worker_count),
                 }
                 self.total_worker_count += n
-                conn.send(reply)
+                conn.send(reply, timeout=self.entry_timeout, hard=True)
+            except socket.timeout:
+                print("entry handshake timed out; dropping slow client")
             except Exception as exc:
                 print("entry handshake failed:", exc)
             finally:
@@ -106,6 +152,62 @@ class WorkerServer(QueueCommunicator):
             self.add_connection(conn)
         print("finished worker server")
 
+    def _heartbeat_loop(self) -> None:
+        """Ping every peer each interval, from OUTSIDE the dispatch path:
+        the learner can be busy for minutes at an epoch boundary (first
+        jit compile) and gathers must still see a live link."""
+        while not self.shutdown_flag:
+            time.sleep(self.heartbeat_interval)
+            for conn in self.connections():
+                self.send(conn, _HB, droppable=True)
+
+    def add_connection(self, conn: FramedConnection) -> None:
+        # ledger exists for the connection's whole lifetime: created here,
+        # removed exactly once by on_disconnect.  _count_jobs never creates
+        # entries, so a frame drained from input_queue AFTER its peer was
+        # reaped cannot resurrect a popped ledger (which would leak the
+        # entry and strand its job counts forever)
+        with self._inflight_lock:
+            self._inflight[conn] = {"g": 0, "e": 0}
+        super().add_connection(conn)
+
+    def _count_jobs(self, conn: FramedConnection, role_counts: Dict[str, int]) -> None:
+        with self._inflight_lock:
+            ledger = self._inflight.get(conn)
+            if ledger is not None:
+                for role, n in role_counts.items():
+                    ledger[role] = max(0, ledger[role] + n)
+                return
+        # peer already reaped.  Positive counts (assignments) can never
+        # come back — hand them to the learner as lost.  Negative counts
+        # are uploads that DID arrive after the disconnect report already
+        # wrote them off wholesale: pass them through too (the learner
+        # subtracts, so a negative count adds the balance back) or the
+        # generation/evaluation ratio skews by that much per disconnect.
+        self._report_lost({k: v for k, v in role_counts.items() if v})
+
+    def _report_lost(self, counts: Dict[str, int]) -> None:
+        if not (counts.get("g") or counts.get("e")) or self.shutdown_flag:
+            return
+
+        def report():
+            try:
+                self.handler("jobs_lost", counts, timeout=30.0)
+            except Exception:
+                pass  # learner already draining; the balance no longer matters
+
+        # own thread: this is reached from on_disconnect, which can run on
+        # the heartbeat or a receiver thread — and the learner can be busy
+        # for minutes at an epoch boundary, so a blocking handler call here
+        # would suppress pings to every OTHER (healthy) peer meanwhile
+        threading.Thread(target=report, daemon=True).start()
+
+    def on_disconnect(self, conn: FramedConnection) -> None:
+        with self._inflight_lock:
+            ledger = self._inflight.pop(conn, None)
+        if ledger:
+            self._report_lost(ledger)
+
     def _dispatch(self) -> None:
         import queue as _queue
 
@@ -116,10 +218,23 @@ class WorkerServer(QueueCommunicator):
                 continue
             except (TypeError, ValueError):
                 continue
+            if req == "heartbeat":
+                continue  # liveness traffic only; no reply by design
             if req == "model":
                 self.send(conn, self._model_bytes(int(data)))
-            else:
-                self.send(conn, self.handler(req, data))
+                continue
+            reply = self.handler(req, data)
+            if req == "args" and isinstance(reply, list):
+                roles: Dict[str, int] = {"g": 0, "e": 0}
+                for a in reply:
+                    if a is not None:
+                        roles[a["role"]] += 1
+                self._count_jobs(conn, roles)
+            elif req in ("episode", "result"):
+                role = "g" if req == "episode" else "e"
+                n = len(data) if isinstance(data, list) else 1
+                self._count_jobs(conn, {role: -n})
+            self.send(conn, reply)
 
     def _model_bytes(self, requested_id: int):
         """(model_id, params_blob) for a snapshot id (train.py:604-614).
@@ -134,15 +249,19 @@ class WorkerServer(QueueCommunicator):
             if cached is not None:
                 return requested_id, cached
             try:
-                params = load_params(
-                    model_path(self.model_server.model_dir, requested_id), latest_params
+                # digest-verified: serving a silently-corrupt snapshot to a
+                # whole worker machine poisons every episode it generates
+                params = load_verified_params(
+                    self.model_server.model_dir, requested_id, latest_params
                 )
                 blob = params_to_bytes(params)
                 self._trim_blob_cache()
                 self._blob_cache[requested_id] = blob
                 return requested_id, blob
             except Exception:
-                pass  # fall back to latest (reference train.py:608-613)
+                # CheckpointError (digest mismatch) included: fall back to
+                # latest (reference train.py:608-613)
+                pass
         cached = self._blob_cache.get(latest_id)
         if cached is None:
             # id and params read atomically above, so the cache key is honest
@@ -226,19 +345,70 @@ class RemoteGather:
     """One data connection multiplexing ~16 actor threads (worker.py:99-173).
 
     Prefetches job args in blocks and flushes episode/result uploads in
-    blocks; all RPCs are serialized on the single connection.
+    blocks; all RPCs are serialized on the single connection.  Every RPC
+    runs under a deadline: the reply wait tolerates server heartbeat
+    frames (the learner can be minutes inside an epoch boundary while the
+    link stays provably alive) but ~3 silent heartbeat intervals raise,
+    mark the gather ``failed``, and trigger the cluster's rejoin path.
     """
 
-    def __init__(self, conn: FramedConnection, n_workers: int):
+    def __init__(
+        self,
+        conn: FramedConnection,
+        n_workers: int,
+        heartbeat_interval: float = 10.0,
+        io_timeout: float = 60.0,
+    ):
         self.conn = conn
         self.buffer_length = 1 + n_workers // 4
+        self.io_timeout = io_timeout
+        self.hb_timeout = (
+            max(3.0 * heartbeat_interval, io_timeout) if heartbeat_interval > 0 else None
+        )
         self._lock = threading.Lock()
         self._args_queue: List[Any] = []
         self._uploads: Dict[str, List[Any]] = {"episode": [], "result": []}
         self.closed = False
+        self.failed = False
+
+    def _rpc(self, payload: Any) -> Any:
+        """send + recv-until-reply, discarding interleaved server
+        heartbeats (each one restarts the silence deadline)."""
+        if self.failed:
+            # a previous deadline fired, possibly mid-frame: the stream may
+            # be desynchronized (a late reply to the timed-out RPC would be
+            # read as THIS call's reply) — nothing may use it again
+            raise ConnectionResetError("gather link failed; stream not reusable")
+        try:
+            self.conn.send(payload, timeout=self.io_timeout)
+            while True:
+                frame = self.conn.recv(timeout=self.hb_timeout)
+                if _is_hb(frame):
+                    continue
+                return frame
+        except (socket.timeout, ConnectionResetError, BrokenPipeError, OSError):
+            self.failed = True
+            raise
+
+    def ping(self) -> None:
+        """One-way liveness frame; bypasses the RPC lock on purpose — the
+        send must flow even while an RPC waits minutes for its reply, or
+        the server would drop this link as silent mid-epoch-boundary.
+        Non-blocking at the frame level too (``try_send``): a frame
+        already in flight IS liveness traffic, and blocking here would
+        starve the single ping thread's other gathers behind one slow
+        upload."""
+        if self.closed or self.failed:
+            return
+        try:
+            self.conn.try_send(("heartbeat", None), timeout=self.io_timeout)
+        except (socket.timeout, ConnectionResetError, BrokenPipeError, OSError):
+            self.failed = True
 
     def __call__(self, req: str, data: Any) -> Any:
         with self._lock:
+            if self.failed:
+                return None  # actors drain; the cluster is tearing down
             if req == "args":
                 return self._next_args()
             if req in self._uploads:
@@ -248,7 +418,7 @@ class RemoteGather:
                 return None
             if self.closed:
                 return None
-            return send_recv(self.conn, (req, data))
+            return self._rpc((req, data))
 
     def _next_args(self) -> Optional[Dict[str, Any]]:
         if self.closed:
@@ -256,7 +426,7 @@ class RemoteGather:
         if not self._args_queue:
             for req in ("episode", "result"):
                 self._flush(req)  # don't let uploads sit behind idle prefetch
-            batch = send_recv(self.conn, ("args", self.buffer_length))
+            batch = self._rpc(("args", self.buffer_length))
             if batch is None:
                 self.close()
                 return None
@@ -268,50 +438,102 @@ class RemoteGather:
 
     def _flush(self, req: str) -> None:
         if self._uploads[req] and not self.closed:
-            send_recv(self.conn, (req, self._uploads[req]))
+            self._rpc((req, self._uploads[req]))
             self._uploads[req] = []
 
     def fetch_model(self, model_id: int) -> tuple:
         with self._lock:
             if self.closed:
                 raise ConnectionResetError("gather connection closed")
-            return send_recv(self.conn, ("model", model_id))
+            return self._rpc(("model", model_id))
 
-    def close(self) -> None:
+    def close(self, abort: bool = False) -> None:
+        """``abort`` skips the final upload flush — used when the link (or
+        a sibling gather's link) already failed and blocking on a dead
+        socket would stall the whole teardown."""
         if not self.closed:
-            for req in ("episode", "result"):
-                try:
-                    self._flush(req)
-                except OSError:
-                    pass
+            if not abort and not self.failed:
+                for req in ("episode", "result"):
+                    try:
+                        self._flush(req)
+                    except OSError:
+                        break
             self.closed = True
             self.conn.close()
 
 
 class RemoteWorkerCluster:
-    """Worker-machine main (reference RemoteWorkerCluster, worker.py:235-261)."""
+    """Worker-machine main (reference RemoteWorkerCluster, worker.py:235-261).
+
+    ``run()`` is a supervision loop: one *session* (entry handshake, data
+    connections, actor threads) runs until either the learner drains it
+    cleanly (job assignment returns None → exit) or a connection fails —
+    then every gather is torn down, every actor thread exits, and the
+    machine re-enters through the entry port with exponential backoff.
+    """
 
     def __init__(self, worker_args: Dict[str, Any]):
         self.worker_args = dict(worker_args)
         self.server_address = worker_args["server_address"]
         self.entry_port = int(worker_args.get("entry_port", ENTRY_PORT))
         self.num_parallel = int(worker_args.get("num_parallel", 8))
+        self.rejoin = bool(worker_args.get("rejoin", True))
+        self.rejoin_backoff = float(worker_args.get("rejoin_backoff", 1.0))
+        self.rejoin_backoff_max = float(worker_args.get("rejoin_backoff_max", 60.0))
+        self.max_rejoins = int(worker_args.get("max_rejoins", -1))
+        self.entry_retry_seconds = float(worker_args.get("entry_retry_seconds", 60.0))
 
-    def _entry(self, retry_seconds: float = 60.0) -> Dict[str, Any]:
+    def _entry(self) -> Dict[str, Any]:
         conn = connect_socket_connection(
-            self.server_address, self.entry_port, retry_seconds=retry_seconds
+            self.server_address, self.entry_port,
+            retry_seconds=self.entry_retry_seconds,
         )
         try:
-            return send_recv(conn, dict(self.worker_args, num_parallel=self.num_parallel))
+            return send_recv(conn, dict(self.worker_args, num_parallel=self.num_parallel),
+                             timeout=30.0)
         finally:
             conn.close()
 
     def run(self) -> None:
+        backoff = self.rejoin_backoff
+        rejoins = 0
+        while True:
+            t0 = time.monotonic()
+            try:
+                clean = self._run_session()
+            except (socket.timeout, OSError) as exc:
+                print(f"worker session failed: {type(exc).__name__}: {exc}")
+                clean = False
+            if clean or time.monotonic() - t0 > self.rejoin_backoff_max:
+                # a session that ended clean OR genuinely worked for a
+                # while (outlived the max backoff) resets the clock —
+                # max_rejoins bounds CONSECUTIVE failures, not lifetime
+                # blips spread over weeks of healthy sessions; a server
+                # crash-looping seconds after each join must NOT reset,
+                # or the budget and the exponential backoff never bite
+                backoff = self.rejoin_backoff
+                rejoins = 0
+            if clean or not self.rejoin:
+                return
+            rejoins += 1
+            if 0 <= self.max_rejoins < rejoins:
+                print(f"giving up after {self.max_rejoins} rejoins")
+                return
+            print(f"rejoining server in {backoff:.1f}s")
+            time.sleep(backoff)
+            backoff = min(backoff * 2.0, self.rejoin_backoff_max)
+
+    def _run_session(self) -> bool:
+        """One join→work→drain cycle.  True = the learner drained us
+        cleanly (run over); False = a connection failed mid-session."""
         cfg = self._entry()
         args = dict(cfg["train_args"])
         args["env"] = cfg["env_args"]
         base_worker_id = cfg["worker_args"].get("base_worker_id", 0)
-        data_port = int(args["worker"].get("data_port", DATA_PORT))
+        worker_cfg = args["worker"]
+        data_port = int(worker_cfg.get("data_port", DATA_PORT))
+        heartbeat_interval = float(worker_cfg.get("heartbeat_interval", 10.0))
+        io_timeout = float(worker_cfg.get("socket_timeout", 60.0))
         prepare_env(args["env"])
 
         num_gathers = 1 + (self.num_parallel - 1) // 16
@@ -320,29 +542,57 @@ class RemoteWorkerCluster:
         for g in range(num_gathers):
             share = self.num_parallel // num_gathers + int(g < self.num_parallel % num_gathers)
             conn = connect_socket_connection(self.server_address, data_port)
-            gathers.append(RemoteGather(conn, share))
+            gathers.append(RemoteGather(conn, share, heartbeat_interval, io_timeout))
             shares.append(share)
 
-        model_server = RemoteModelServer(
-            make_env(args["env"]).net(), make_env(args["env"]), args, gathers[0].fetch_model
-        )
+        # pings start BEFORE the model server's blocking initial fetch: the
+        # other gathers would otherwise sit silent through a whole params
+        # download + env/net init, and the server's ~3-interval silence
+        # deadline would reap them before this machine ever got going
+        ping_stop = threading.Event()
+        if heartbeat_interval > 0:
+            def _ping_loop():
+                while not ping_stop.is_set():
+                    for g in gathers:
+                        g.ping()
+                    ping_stop.wait(heartbeat_interval)
 
-        threads: List[threading.Thread] = []
-        wid = base_worker_id
-        for gather, share in zip(gathers, shares):
-            for _ in range(share):
-                worker = Worker(make_env(args["env"]), args, gather, model_server, wid)
-                t = threading.Thread(target=worker.run, daemon=True, name=f"remote-actor-{wid}")
-                t.start()
-                threads.append(t)
-                wid += 1
+            threading.Thread(target=_ping_loop, daemon=True).start()
+
+        model_server = None
         try:
+            model_server = RemoteModelServer(
+                make_env(args["env"]).net(), make_env(args["env"]), args,
+                gathers[0].fetch_model,
+            )
+
+            threads: List[threading.Thread] = []
+            wid = base_worker_id
+            for gather, share in zip(gathers, shares):
+                for _ in range(share):
+                    worker = Worker(make_env(args["env"]), args, gather, model_server, wid)
+                    t = threading.Thread(target=worker.run, daemon=True, name=f"remote-actor-{wid}")
+                    t.start()
+                    threads.append(t)
+                    wid += 1
+            while any(t.is_alive() for t in threads):
+                if any(g.failed for g in gathers):
+                    # one dead link poisons the session: abort every gather
+                    # so each blocked RPC raises and its actors exit — no
+                    # thread may outlive the session (rejoin would leak it)
+                    for g in gathers:
+                        g.close(abort=True)
+                time.sleep(0.2)
             for t in threads:
                 t.join()
         finally:
+            ping_stop.set()
+            failed = any(g.failed for g in gathers)
             for gather in gathers:
-                gather.close()
-            model_server.stop()
+                gather.close(abort=failed)
+            if model_server is not None:
+                model_server.stop()
+        return not failed
 
 
 def worker_main(args: Dict[str, Any], argv: Optional[List[str]] = None) -> None:

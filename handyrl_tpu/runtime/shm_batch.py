@@ -11,9 +11,26 @@ design point (reference train.py:271-401 forks num_batchers processes):
     ------                                ---------------------------------
     EpisodeStore ──codec blobs──▶ feed_q ─▶ replica EpisodeStore
                                             sample local_batch windows
-    free_q ◀──────────── slot indices ◀──── fill_batch into shm slot views
-    ready_q ◀─ (slot, stage timings) ◀────┘
+    free_q[i] ────────── slot indices ────▶ fill_batch into shm slot views
+    ready pipe ◀─ fixed-size records ◀────┘
     device-put thread: slot views ─▶ ctx.put_batch ─▶ device queue
+
+    Both slot channels are designed to survive a SIGKILL'd child, which
+    dies holding whatever lock it was inside:
+
+    * Free slots travel through PER-CHILD ``mp.Queue``s (the parent deals
+      recycled slots round-robin), not one shared queue — ``Queue.get``
+      holds its reader lock for the whole blocking wait, so a kill almost
+      always catches the victim INSIDE the lock; per-child queues mean a
+      dead child can only poison itself.
+    * Ready messages travel over a raw ``os.pipe`` as fixed-size structs
+      (far below PIPE_BUF, so every write is kernel-atomic and LOCK-FREE).
+      An ``mp.Queue`` here would wedge the survivors a different way: each
+      writer's queue-feeder thread takes a shared write lock per message,
+      and a kill mid-write leaves that lock dead — the survivors' feeders
+      then buffer forever and nothing reaches the parent (observed as
+      qsize growing while poll() stays empty).  A killed pipe writer, by
+      contrast, leaves a whole record or nothing.
 
 Zero-copy by construction: batches have fixed (B, T, P, ...) shapes
 (runtime/batch.py), so each ring slot is a preallocated columnar layout in
@@ -30,6 +47,20 @@ its own recency-biased replica store — per-batch sampling then costs the
 parent nothing.  Every stage is timed (sample / assemble / free-slot wait
 / ready wait / device put / device-queue depth) and surfaced through
 ``stats()`` into metrics.jsonl and bench.py.
+
+Supervision (docs/fault_tolerance.md): the parent watches its children.
+An OOM-killed / SIGKILL'd batcher process no longer starves the trainer
+silently — the consumer loop notices the dead child, reclaims every ring
+slot dealt to it (the parent stamps a shared ownership array BEFORE each
+deal, so no slot is ever unattributed; a per-slot generation counter
+makes any in-flight ready message for a reclaimed slot self-invalidating,
+so a slot can never circulate twice), redistributes those slots to the
+survivors, respawns the child up to ``batcher_max_restarts`` times, and
+past that — or if the ring stays wedged for ``batcher_stall_timeout``
+after a death (the narrow remaining window: a SIGKILL inside the shared
+ready queue's write lock) — degrades loudly to the threaded pipeline.
+Deaths, restarts and the fallback flip are counted in ``stats()`` and
+land in metrics.jsonl as ``pipe_batcher_*`` events.
 """
 
 from __future__ import annotations
@@ -38,6 +69,7 @@ import atexit
 import multiprocessing as mp
 import os
 import queue as thqueue
+import struct
 import sys
 import threading
 import time
@@ -50,10 +82,18 @@ import numpy as np
 
 from . import codec
 from .batch import fill_batch, make_batch
+from .connection import _wait_io
 from .replay import EpisodeStore
-from .trainer import PIPE_STAT_KEYS
+from .trainer import PIPE_EVENT_KEYS, PIPE_STAT_KEYS
 
 _ALIGN = 64  # cache-line-align every leaf inside a slot
+
+# one ready message: slot (-1 = "this child hit an exception and is
+# exiting"), slot generation, sample/assemble/free-wait timings.  36 bytes,
+# far under PIPE_BUF (>= 512 by POSIX, 4096 on Linux): os.write of a whole
+# record is atomic, so records from concurrent children never interleave
+# and a SIGKILL'd writer can never leave a torn record in the pipe
+_READY_REC = struct.Struct("=iQddd")
 
 
 def slot_spec(template: Dict[str, Any]):
@@ -108,14 +148,23 @@ def _drain_feed(feed_q, store: EpisodeStore) -> None:
 
 
 def _batcher_main(shm_name, spec, slot_bytes, args, local_batch, seed,
-                  feed_q, free_q, ready_q, stop) -> None:
+                  feed_q, free_q, ready_w, stop, slot_gen) -> None:
     """Child entry point: replica store -> sample -> fill shm slot.
 
     Runs under fork (Linux default) or spawn; everything it needs arrives
     through its arguments, and fork-inherited module state that could
     carry a held lock is re-created first.  Never touches jax arrays or
     the device — pure numpy + zlib + codec, i.e. C code that releases the
-    GIL it no longer shares with the learner anyway."""
+    GIL it no longer shares with the learner anyway.
+
+    Crash-safety protocol: ``free_q`` is this child's PRIVATE free-slot
+    queue — the parent stamped ``owner[slot]`` before dealing each index
+    into it, so every slot this process holds (queued or in hand) is
+    attributed and reclaimable if it dies, and a kill inside the queue's
+    reader lock wedges nobody else.  The child snapshots
+    ``slot_gen[slot]`` at claim time and sends it with the ready message;
+    reclamation bumps the generation, invalidating any message still in
+    flight so a reclaimed slot can never circulate twice."""
     import random
 
     from . import replay
@@ -134,12 +183,12 @@ def _batcher_main(shm_name, spec, slot_bytes, args, local_batch, seed,
         fs = args["forward_steps"]
         bs = args["burn_in_steps"]
         cs = args["compress_steps"]
-        while not stop.is_set():
+        while not stop.value:
             _drain_feed(feed_q, store)
             t0 = time.perf_counter()
             windows: List[Dict[str, Any]] = []
             while len(windows) < local_batch:
-                if stop.is_set():
+                if stop.value:
                     return
                 w = store.sample_window(fs, bs, cs)
                 if w is None:
@@ -155,9 +204,10 @@ def _batcher_main(shm_name, spec, slot_bytes, args, local_batch, seed,
                 try:
                     slot = free_q.get(timeout=0.2)
                 except thqueue.Empty:
-                    if stop.is_set():
+                    if stop.value:
                         return
                     _drain_feed(feed_q, store)
+            gen = slot_gen[slot]
             t_free = time.perf_counter() - t0
 
             out = views_by_slot.get(slot)
@@ -165,11 +215,14 @@ def _batcher_main(shm_name, spec, slot_bytes, args, local_batch, seed,
                 out = views_by_slot[slot] = slot_views(spec, shm.buf, slot * slot_bytes)
             t0 = time.perf_counter()
             fill_batch(windows, args, out)
-            ready_q.put((slot, t_sample, time.perf_counter() - t0, t_free))
+            os.write(ready_w, _READY_REC.pack(
+                slot, gen, t_sample, time.perf_counter() - t0, t_free
+            ))
     except Exception:
-        traceback.print_exc()
+        traceback.print_exc()  # full detail to stderr; the record below
+        # just tells the parent this child is exiting abnormally
         try:
-            ready_q.put(("error", traceback.format_exc(limit=5)))
+            os.write(ready_w, _READY_REC.pack(-1, 0, 0.0, 0.0, 0.0))
         except Exception:
             pass
     finally:
@@ -189,7 +242,8 @@ class ShmBatchPipeline:
 
     Drop-in for trainer.BatchPipeline: same constructor signature, same
     ``start()``/``batch()`` surface, plus ``stop()`` (join children +
-    unlink the segment) and ``stats()`` (per-stage cumulative timings).
+    unlink the segment) and ``stats()`` (per-stage cumulative timings +
+    supervision event counters).
     """
 
     mode = "shm"
@@ -225,9 +279,17 @@ class ShmBatchPipeline:
         self._fallback = None
         self._lock = threading.Lock()
         self._stats: Dict[str, float] = {k: 0.0 for k in PIPE_STAT_KEYS}
+        self._stats.update({k: 0.0 for k in PIPE_EVENT_KEYS})
         self._stats.update(batches=0.0, device_queue_depth_sum=0.0, gets=0.0)
         self._pending: deque = deque()
         self._pending_cv = threading.Condition()
+        # supervision state (consumer-thread only, except the counters)
+        self._max_restarts = int(args.get("batcher_max_restarts", 3))
+        self._stall_timeout = float(args.get("batcher_stall_timeout", 60.0))
+        self._restarts = 0
+        self._had_death = False
+        self._last_child_check = 0.0
+        self._last_death = 0.0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -280,11 +342,32 @@ class ShmBatchPipeline:
             create=True, size=self._slot_bytes * self._n_slots
         )
         atexit.register(self._unlink_quiet)
-        self._free_q = self._mp.Queue()
+        if "fork" not in mp.get_all_start_methods():
+            # the ready pipe rides fork fd inheritance; platforms without
+            # fork take the (loud) threaded fallback via start()'s handler
+            raise RuntimeError(
+                "shm batch pipeline requires the fork start method "
+                "(ready-pipe fds are fork-inherited)"
+            )
+        self._ready_r, self._ready_w = os.pipe()
+        self._ready_buf = b""
+        # lock-FREE stop flag, not mp.Event: Event.is_set() takes the
+        # event's shared condition lock, and children poll the flag in
+        # their hottest loop — a SIGKILL landing inside that lock would
+        # wedge every surviving child forever.  A raw shared int has no
+        # lock to die holding.
+        self._mp_stop = self._mp.Value("i", 0, lock=False)
+        # slot ownership + generation (see _batcher_main docstring for the
+        # crash-safety protocol); both are lock-free because the PARENT is
+        # the only writer: owner[slot] is stamped before each deal and
+        # cleared on receipt, slot_gen[slot] bumps only while the slot is
+        # in the parent's domain
+        self._owner = self._mp.Array("i", self._n_slots, lock=False)
+        self._slot_gen = self._mp.Array("L", self._n_slots, lock=False)
         for i in range(self._n_slots):
-            self._free_q.put(i)
-        self._ready_q = self._mp.Queue()
-        self._mp_stop = self._mp.Event()
+            self._owner[i] = -1
+        self._deal_rr = 0
+        self._orphan_slots: List[int] = []
         self._slot_views = [
             slot_views(self._spec, self._shm.buf, i * self._slot_bytes)
             for i in range(self._n_slots)
@@ -298,33 +381,60 @@ class ShmBatchPipeline:
         # missing one is a hole in the children's data forever
         self.store.subscribe(self._on_episodes)
         snapshot = [codec.dumps(ep) for ep in self.store.snapshot()]
-        for i in range(max(1, int(self.args["num_batchers"]))):
-            feed_q = self._mp.Queue()
-            for blob in snapshot:
-                feed_q.put(blob)
-            self._feed_qs.append(feed_q)
-            proc = self._mp.Process(
-                target=_batcher_main,
-                args=(self._shm.name, self._spec, self._slot_bytes, self.args,
-                      self._local_batch, int(self.args.get("seed", 0)) + i,
-                      feed_q, self._free_q, self._ready_q, self._mp_stop),
-                daemon=True,
-            )
-            import warnings
-
-            with warnings.catch_warnings():
-                # jax warns that fork + its internal threads can deadlock;
-                # these children never call into jax/XLA (pure numpy +
-                # zlib + codec, and replay.reset_block_cache() re-creates
-                # the one inherited lock they touch), so the general
-                # warning does not apply to this fork
-                warnings.filterwarnings(
-                    "ignore", message="os.fork", category=RuntimeWarning
-                )
-                proc.start()
-            self._procs.append(proc)
+        n = max(1, int(self.args["num_batchers"]))
+        self._procs = [None] * n
+        self._feed_qs = [None] * n
+        self._free_qs = [None] * n
+        for i in range(n):
+            self._spawn_child(i, snapshot)
+        for slot in range(self._n_slots):
+            self._deal_slot(slot)
         threading.Thread(target=self._feeder_loop, daemon=True).start()
-        threading.Thread(target=self._device_put_loop, daemon=True).start()
+        self._consumer_thread = threading.Thread(
+            target=self._device_put_loop, daemon=True
+        )
+        self._consumer_thread.start()
+
+    def _spawn_child(self, i: int, snapshot: Optional[List[bytes]] = None) -> None:
+        """(Re)start batcher child ``i`` with a fresh replica feed from the
+        parent's authoritative store."""
+        feed_q = self._mp.Queue()
+        # publish BEFORE snapshotting — the respawn path runs with the
+        # feeder live, and an episode arriving between the snapshot and
+        # the publication would go to the dead child's orphaned queue: a
+        # permanent hole in the replica.  This order can deliver such an
+        # episode twice (live feed + snapshot), which replica stores
+        # tolerate by design (same reasoning as subscribe-before-snapshot
+        # in _spawn_children)
+        self._feed_qs[i] = feed_q
+        if snapshot is None:
+            snapshot = [codec.dumps(ep) for ep in self.store.snapshot()]
+        for blob in snapshot:
+            feed_q.put(blob)
+        free_q = self._mp.Queue()
+        self._free_qs[i] = free_q
+        proc = self._mp.Process(
+            target=_batcher_main,
+            args=(self._shm.name, self._spec, self._slot_bytes, self.args,
+                  self._local_batch,
+                  int(self.args.get("seed", 0)) + i + 7919 * self._restarts,
+                  feed_q, free_q, self._ready_w, self._mp_stop,
+                  self._slot_gen),
+            daemon=True,
+        )
+        import warnings
+
+        with warnings.catch_warnings():
+            # jax warns that fork + its internal threads can deadlock;
+            # these children never call into jax/XLA (pure numpy +
+            # zlib + codec, and replay.reset_block_cache() re-creates
+            # the one inherited lock they touch), so the general
+            # warning does not apply to this fork
+            warnings.filterwarnings(
+                "ignore", message="os.fork", category=RuntimeWarning
+            )
+            proc.start()
+        self._procs[i] = proc
 
     def _on_episodes(self, episodes: List[Dict[str, Any]]) -> None:
         # store.extend runs on the learner's server thread — only queue a
@@ -343,31 +453,203 @@ class ShmBatchPipeline:
                     self._pending.clear()
                 for episode in batch:
                     blob = codec.dumps(episode)
-                    for feed_q in self._feed_qs:
-                        feed_q.put(blob)
+                    for feed_q in tuple(self._feed_qs):
+                        if feed_q is None:
+                            continue
+                        try:
+                            feed_q.put(blob)
+                        except Exception:
+                            pass  # queue of a child being replaced; its
+                            # successor reseeds from the store snapshot
         except Exception:
             traceback.print_exc()
 
+    # -- slot dealing --------------------------------------------------------
+
+    def _deal_slot(self, slot: int) -> None:
+        """Hand a free slot to a live child's private queue (round-robin),
+        stamping ownership FIRST so the slot is attributed at every
+        instant it is outside the parent's hands — a child killed at any
+        point can have all its slots reclaimed."""
+        n = len(self._procs)
+        for off in range(n):
+            i = (self._deal_rr + off) % n
+            if self._procs[i] is not None:
+                self._deal_rr = (i + 1) % n
+                self._owner[slot] = i
+                self._free_qs[i].put(slot)
+                return
+        # every child is currently dead (between death and respawn, or
+        # headed for degradation): park the slot; respawn re-deals it
+        self._orphan_slots.append(slot)
+
+    # -- supervision ---------------------------------------------------------
+
+    def _check_children(self) -> None:
+        """Reap dead batcher children: reclaim their ring slots, respawn
+        within budget, degrade to the thread pipeline past it.  Runs on
+        the consumer thread only (throttled)."""
+        now = time.monotonic()
+        if now - self._last_child_check < 0.25 or self._fallback is not None:
+            return
+        self._last_child_check = now
+        for i, proc in enumerate(self._procs):
+            if proc is None or proc.is_alive():
+                continue
+            exitcode = proc.exitcode
+            self._procs[i] = None
+            self._had_death = True
+            self._last_death = now
+            with self._lock:
+                self._stats["batcher_deaths"] += 1
+            # reclaim every slot dealt to the dead child — queued in its
+            # private free queue or claimed in its hands, all are stamped
+            # with its index.  Bump the generation FIRST: any ready
+            # message the dead child managed to send is now stale and will
+            # be discarded, so a slot can never circulate twice.  The dead
+            # child's queue is abandoned unread (its reader lock may have
+            # died with it); the slots are re-dealt to the survivors.
+            reclaimed = []
+            for slot in range(self._n_slots):
+                if self._owner[slot] == i:
+                    self._owner[slot] = -1
+                    self._slot_gen[slot] += 1
+                    reclaimed.append(slot)
+            # retire BOTH of the dead child's queues.  cancel_join_thread
+            # is the critical call: the feed queue's internal feeder
+            # thread can be blocked forever on a full unread pipe, and
+            # multiprocessing's exit finalizer would otherwise join it —
+            # hanging learner shutdown after any batcher death
+            for old_q in (self._free_qs[i], self._feed_qs[i]):
+                if old_q is not None:
+                    try:
+                        old_q.cancel_join_thread()
+                        old_q.close()
+                    except Exception:
+                        pass
+            self._free_qs[i] = None
+            self._feed_qs[i] = None
+            print(
+                f"[handyrl_tpu] batcher process {i} died (exitcode {exitcode}); "
+                f"reclaimed ring slots {reclaimed}",
+                file=sys.stderr,
+            )
+            for slot in reclaimed:
+                self._deal_slot(slot)  # survivors keep the ring flowing NOW
+            if self._restarts >= self._max_restarts:
+                self._degrade(
+                    f"restart budget exhausted ({self._max_restarts})"
+                )
+                return
+            self._restarts += 1
+            with self._lock:
+                self._stats["batcher_restarts"] += 1
+            try:
+                self._spawn_child(i)
+                print(
+                    f"[handyrl_tpu] batcher process {i} respawned "
+                    f"(restart {self._restarts}/{self._max_restarts})",
+                    file=sys.stderr,
+                )
+            except Exception:
+                traceback.print_exc()
+                self._degrade("batcher respawn failed")
+                return
+            for slot in self._orphan_slots:
+                self._deal_slot(slot)
+            self._orphan_slots = []
+
+    def _degrade(self, reason: str) -> None:
+        """Swap in the threaded pipeline.  Loud: a degraded assembly plane
+        changes the learner's throughput profile and must be visible in
+        logs AND metrics (``pipe_batcher_fallback`` flips to 1, the
+        ``pipeline`` mode field flips to 'thread')."""
+        print(
+            f"[handyrl_tpu] shm batch pipeline degrading to threaded "
+            f"batchers: {reason}",
+            file=sys.stderr,
+        )
+        from .trainer import BatchPipeline
+
+        fallback = BatchPipeline(self.args, self.store, self.ctx, self.stop_event)
+        with self._lock:
+            # carry ALL cumulative counters across the mode flip — the
+            # trainer diffs stage timings per epoch, so a fresh-zeroed
+            # fallback would make the degradation epoch's pipe_* records
+            # go negative; the event counts must survive too
+            fallback._stats.update(self._stats)
+            fallback._stats["batcher_fallback"] = 1.0
+        fallback.start()
+        self._fallback = fallback
+
     # -- consumer side -------------------------------------------------------
+
+    def _ready_next_record(self):
+        """Next whole record from the ready pipe, or None after ~0.3s of
+        nothing.  Writes are atomic (<= PIPE_BUF) so only READS can split
+        a record — the carry buffer handles that."""
+        if len(self._ready_buf) < _READY_REC.size:
+            try:
+                _wait_io(self._ready_r, False, time.monotonic() + 0.3)
+            except TimeoutError:  # covers socket.timeout (py>=3.10 alias)
+                return None
+            chunk = os.read(self._ready_r, 4096)
+            if not chunk:
+                return None  # all writers closed (teardown)
+            self._ready_buf += chunk
+        if len(self._ready_buf) < _READY_REC.size:
+            return None
+        record = _READY_REC.unpack(self._ready_buf[: _READY_REC.size])
+        self._ready_buf = self._ready_buf[_READY_REC.size:]
+        return record
 
     def _ready_get(self):
         t0 = time.perf_counter()
+        t_enter = time.monotonic()
         while not self.stop_event.is_set():
-            try:
-                item = self._ready_q.get(timeout=0.3)
-            except thqueue.Empty:
+            self._check_children()
+            if self._fallback is not None:
+                return None
+            item = self._ready_next_record()
+            if item is None:
+                # no shared-lock wedge mode is known to remain, but keep a
+                # last-resort watchdog: after a death, zero ready traffic
+                # for this long means give up on the shm plane.  The clock
+                # baselines on THIS call's entry (and the death, if later):
+                # time the consumer spent elsewhere — device-queue
+                # backpressure, a minutes-long first jit compile — must not
+                # count as ring stall, or a death coinciding with an epoch
+                # boundary would spuriously and permanently degrade
+                if (
+                    self._had_death
+                    and time.monotonic() - max(t_enter, self._last_death)
+                    > self._stall_timeout
+                ):
+                    self._degrade(
+                        f"ring stalled > {self._stall_timeout:.0f}s after a "
+                        "batcher death"
+                    )
+                    return None
                 continue
-            if item and item[0] == "error":
-                # a dead silent pipeline deadlocks the trainer — fail loudly
+            slot, gen, t_sample, t_assemble, t_free = item
+            if slot < 0:
+                # the child printed its traceback and is exiting;
+                # supervision reaps it (respawn or degrade) — a one-off
+                # fill failure must not take down the whole training run
                 print(
-                    "[handyrl_tpu] batcher process died:\n" + str(item[1]),
+                    "[handyrl_tpu] a batcher process failed (traceback on "
+                    "its stderr) and will be reaped",
                     file=sys.stderr,
                 )
-                self.stop_event.set()
-                return None
+                continue
+            if gen != self._slot_gen[slot]:
+                continue  # stale: produced by a child that died; the slot
+                # was already reclaimed and may be refilling right now
+            self._owner[slot] = -1
+            self._had_death = False  # ring proved itself post-death: disarm
             with self._lock:
                 self._stats["ready_wait_s"] += time.perf_counter() - t0
-            return item
+            return slot, t_sample, t_assemble, t_free
         return None
 
     def _device_put_loop(self) -> None:
@@ -379,6 +661,11 @@ class ShmBatchPipeline:
                 while len(group) < self._fused:
                     item = self._ready_get()
                     if item is None:
+                        # shutdown OR degradation: recycle this partial
+                        # group's slots so close() finds a consistent ring
+                        for slot in slots:
+                            self._slot_gen[slot] += 1
+                            self._deal_slot(slot)
                         return
                     slot, t_sample, t_assemble, t_free = item
                     with self._lock:
@@ -407,13 +694,16 @@ class ShmBatchPipeline:
                 with self._lock:
                     self._stats["put_s"] += time.perf_counter() - t0
                 for slot in slots:
-                    self._free_q.put(slot)
+                    self._slot_gen[slot] += 1
+                    self._deal_slot(slot)
                 if not queued:
                     return
         except Exception:
             traceback.print_exc()
             self.stop_event.set()
         finally:
+            # degradation keeps the learner alive on the thread pipeline;
+            # the shm plane itself still tears down completely
             self.close()
 
     def _put_device(self, item) -> bool:
@@ -422,6 +712,12 @@ class ShmBatchPipeline:
                 self._device_queue.put(item, timeout=0.3)
                 return True
             except thqueue.Full:
+                # a full device queue parks the consumer thread HERE, not
+                # in _ready_get — keep supervising or a child death would
+                # go unnoticed until the trainer drains a batch
+                self._check_children()
+                if self._fallback is not None:
+                    return False  # degraded: nobody drains this queue now
                 continue
         return False
 
@@ -433,6 +729,9 @@ class ShmBatchPipeline:
             self._stats["device_queue_depth_sum"] += self._device_queue.qsize()
             self._stats["gets"] += 1
         while not self.stop_event.is_set():
+            if self._fallback is not None:
+                # degraded mid-wait: the device queue will never fill again
+                return self._fallback.batch()
             try:
                 return self._device_queue.get(timeout=0.3)
             except thqueue.Empty:
@@ -443,8 +742,6 @@ class ShmBatchPipeline:
 
     def stop(self) -> None:
         self.stop_event.set()
-        if self._fallback is not None:
-            return
         self.close()
 
     def close(self) -> None:
@@ -454,27 +751,43 @@ class ShmBatchPipeline:
             self._closed = True
         try:
             # a dead pipeline must stop mirroring the episode stream (its
-            # feeder thread is gone; the pending deque would only grow)
+            # feeder thread is gone; the pending deque would only grow) —
+            # the fallback BatchPipeline samples the store directly
             self.store.unsubscribe(self._on_episodes)
         except Exception:
             pass
         if self._mp_stop is not None:
-            self._mp_stop.set()
-        for proc in self._procs:
+            self._mp_stop.value = 1
+        procs = [p for p in self._procs if p is not None]
+        for proc in procs:
             proc.join(timeout=5.0)
-        for proc in self._procs:
+        for proc in procs:
             if proc.is_alive():
                 proc.terminate()
                 proc.join(timeout=2.0)
-        for q in self._feed_qs + [getattr(self, "_free_q", None),
-                                  getattr(self, "_ready_q", None)]:
-            if q is None:
-                continue
+        for q in (
+            [q for q in self._feed_qs if q is not None]
+            + [q for q in getattr(self, "_free_qs", []) if q is not None]
+        ):
             try:
                 q.cancel_join_thread()
                 q.close()
             except Exception:
                 pass
+        # the consumer thread polls/reads the ready fds: join it (unless
+        # close() IS running on it, via _device_put_loop's finally) before
+        # closing them — a reused fd number would otherwise let os.read
+        # consume bytes from an unrelated descriptor
+        consumer = getattr(self, "_consumer_thread", None)
+        if consumer is not None and consumer is not threading.current_thread():
+            consumer.join(timeout=5.0)
+        for fd in (getattr(self, "_ready_r", None), getattr(self, "_ready_w", None)):
+            if fd is not None:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+        self._ready_r = self._ready_w = None
         self._slot_views = None
         if self._shm is not None:
             import gc

@@ -47,6 +47,13 @@ from .replay import EpisodeStore
 # imports THIS tuple, so adding a stage cannot silently miss a site
 PIPE_STAT_KEYS = ("sample_s", "assemble_s", "free_wait_s", "ready_wait_s", "put_s")
 
+# supervision event counters (runtime/shm_batch.py): child deaths,
+# respawns, and the degraded-to-thread flip.  Recorded CUMULATIVE in
+# metrics.jsonl (pipe_batcher_*) — a nonzero value anywhere in the run
+# means the assembly plane took a fault, and the per-epoch diff of rare
+# events would mostly print zeros
+PIPE_EVENT_KEYS = ("batcher_deaths", "batcher_restarts", "batcher_fallback")
+
 
 def make_pipeline(args: Dict[str, Any], store: EpisodeStore, ctx: TrainContext,
                   stop_event: Optional[threading.Event] = None):
@@ -90,6 +97,7 @@ class BatchPipeline:
         self._started = False
         self._stats_lock = threading.Lock()
         self._stats: Dict[str, float] = {k: 0.0 for k in PIPE_STAT_KEYS}
+        self._stats.update({k: 0.0 for k in PIPE_EVENT_KEYS})
         self._stats.update(batches=0.0, device_queue_depth_sum=0.0, gets=0.0)
         # under jax.distributed each process assembles its local shard of
         # the global batch (TrainContext.put_batch builds the global array)
@@ -287,11 +295,21 @@ class Trainer:
         continue where they left off.  Returns False (fresh optimizer) when
         the file was written at a different epoch than ``expected_epoch`` —
         restarting from an *earlier* snapshot is a branch, not a resume,
-        and must not adopt the later run's weights.
+        and must not adopt the later run's weights.  An unreadable file
+        (truncated by a crash mid-write in a pre-manifest layout, or
+        garbage) also returns False — a broken optimizer checkpoint must
+        degrade to a fresh optimizer, never kill the resume.
         """
         from .checkpoint import load_train_state
 
-        host = load_train_state(path, self.save_payload(0))
+        try:
+            host = load_train_state(path, self.save_payload(0))
+        except Exception as exc:
+            print(
+                f"state.ckpt unreadable ({type(exc).__name__}: {exc}); "
+                "resuming with a fresh optimizer"
+            )
+            return False
         ckpt_epoch = int(host.pop("epoch"))
         if ckpt_epoch != expected_epoch:
             print(
@@ -416,6 +434,10 @@ class Trainer:
                 self.stats["pipe_" + key] = round(
                     cur.get(key, 0.0) - prev.get(key, 0.0), 4
                 )
+            for key in PIPE_EVENT_KEYS:
+                # cumulative, not diffed: any nonzero value flags that the
+                # assembly plane took a fault at some point this run
+                self.stats["pipe_" + key] = cur.get(key, 0.0)
             gets = cur.get("gets", 0.0) - prev.get("gets", 0.0)
             if gets > 0:
                 self.stats["pipe_device_queue_depth"] = round(

@@ -74,15 +74,18 @@ class LocalModelServer:
             current = self.model_id
         if model_id < 0 or model_id >= current:
             return self.engine.client()
-        # old snapshot from disk; rare (transient stale ids / explicit evals)
-        from .checkpoint import load_params, model_path
+        # old snapshot from disk; rare (transient stale ids / explicit
+        # evals).  Digest-verified: a bit-rotted old snapshot silently
+        # deciding evaluation outcomes would poison the win-rate books.
+        from .checkpoint import load_verified_params
 
         try:
-            params = load_params(
-                model_path(self.model_dir, model_id), self.latest_params()
+            params = load_verified_params(
+                self.model_dir, model_id, self.latest_params()
             )
             return InferenceModel(self.module, {"params": params})
         except Exception:
+            # missing / GC'd / corrupt snapshot: serve latest instead
             return self.engine.client()
 
 
@@ -105,7 +108,10 @@ class Worker:
         from .inference_engine import EngineStopped
 
         while True:
-            args = self.conn("args", None)
+            try:
+                args = self.conn("args", None)
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                break  # transport gone (severed/stalled gather); exit cleanly
             if args is None:
                 break
             role = args["role"]
