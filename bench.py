@@ -886,7 +886,7 @@ def _device_replay_northstar_bench(train_res, duration: float,
         nonlocal vstate, hidden, key
         key, sub = jax.random.split(key)
         vstate, hidden, records = dispatch_serialized(
-            lambda: fn(state["params"], vstate, hidden, sub)
+            lambda: fn(state["params"], vstate, hidden, sub), mesh
         )
         return replay.ingest(records)
 
@@ -916,8 +916,14 @@ def _device_replay_northstar_bench(train_res, duration: float,
     rollout_s = 0.0
     while True:
         tr = time.perf_counter()
+        # the rollout stays ASYNC: no per-iteration host sync on its
+        # stats (the old block_until_ready here handicapped this fused
+        # baseline vs the split-plane stage) — everything drains once
+        # after the window.  rollout_s is therefore time spent IN the
+        # dispatch: on CPU dispatch_serialized blocks until ready so the
+        # duty split is exact; on TPU it is enqueue time only and the
+        # trailing block below folds residual execution into dt.
         stats.append(rollout())
-        jax.block_until_ready(stats[-1]["episodes"])
         rollout_s += time.perf_counter() - tr
         for _ in range(trains_per_rollout):
             key, sub = jax.random.split(key)
@@ -927,6 +933,7 @@ def _device_replay_northstar_bench(train_res, duration: float,
         if dt >= duration and updates > 0:
             break
     jax.block_until_ready(m["total"])
+    jax.block_until_ready(stats[-1]["episodes"])  # drain in-flight rollout work
     dt = time.perf_counter() - t0
     fetched = jax.device_get(stats)
     game_steps = sum(int(s["game_steps"]) for s in fetched)
@@ -956,6 +963,233 @@ def _device_replay_northstar_bench(train_res, duration: float,
         "per_chip_northstar_frac": selfplay_rate / (3125.0 * n_chips),
         "loss_finite": bool(jax.numpy.isfinite(jax.device_get(m["total"]))),
     }
+
+
+def _split_plane_northstar_bench(train_res, duration: float,
+                                 actor_chips: Optional[int] = None,
+                                 n_lanes: int = 128, k_steps: int = 32,
+                                 fused_steps: int = 8,
+                                 param_refresh_updates: int = 8):
+    """North-star v3: DISAGGREGATED planes — self-play pinned to an actor
+    mesh, training to a disjoint learner mesh, running CONCURRENTLY from
+    two host threads under the per-device dispatch locks
+    (parallel/mesh.py).  The fused loop (northstar2) is production-bound
+    by construction: one self-play env-step costs ~100x one trained
+    env-step in device time, so one program queue spends >90% of its time
+    in rollout at every geometry (round-4 sweep).  Splitting the chips
+    removes the time-slicing: the learner plane's rollout share drops to
+    zero and the produce/consume ratio becomes a CHIP-ALLOCATION knob
+    (actor_chips) instead of a duty-cycle compromise.
+
+    Three phases: ring prefill, the actor plane STANDALONE (its unshared
+    rate — the concurrency yardstick), then both planes concurrent.
+    Reports per-plane duty, trained + self-play env-steps/s, the
+    concurrent/standalone self-play ratio, realized param lag, and the
+    cross-mesh transfer rate.
+
+    Reading selfplay_concurrent_frac: on REAL accelerators every chip has
+    its own compute, so ~1.0 means training cost self-play nothing.  On
+    the VIRTUAL CPU mesh all devices share the host's physical cores, so
+    the ratio measures core contention, not plane contention — there the
+    architecture proof is rollout_time_frac = 0 with both planes
+    progressing inside one window (the 4-device smoke in
+    tests/test_plane.py asserts exactly that)."""
+    import jax
+
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.parallel import TrainContext
+    from handyrl_tpu.parallel.mesh import dispatch_serialized, split_mesh
+    from handyrl_tpu.runtime.device_replay import DeviceReplay
+    from handyrl_tpu.runtime.device_rollout import build_streaming_fn
+    from handyrl_tpu.runtime.plane import PlaneParamCache, RecordTransfer
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return {"skipped": f"plane: split needs >= 2 devices, have {len(devices)}"}
+    args, module = train_res["args"], train_res["module"]
+    env = make_env(args["env"])
+    venv = env.vector_env()
+    if actor_chips is None:
+        actor_chips = max(1, len(devices) // 2)
+    if jax.default_backend() != "tpu":
+        n_lanes = min(n_lanes, 32)
+        # scan-bodied collectives across VIRTUAL devices run at
+        # pathological speed on XLA:CPU (see Trainer's fused_steps guard)
+        fused_steps = 1
+    learner_mesh, actor_mesh = split_mesh(args.get("mesh"), actor_chips)
+    ldp = learner_mesh.shape.get("dp", 1)
+    adp = actor_mesh.shape.get("dp", 1)
+    import math
+
+    largs = dict(args)
+    if largs["batch_size"] % ldp:
+        largs["batch_size"] = max(ldp, largs["batch_size"] // ldp * ldp)
+    # lanes shard over the actor mesh (rollout) AND the learner mesh
+    # (rings): round to a multiple of both dp sizes
+    lanes_q = ldp * adp // math.gcd(ldp, adp)
+    n_lanes = max(lanes_q, n_lanes // lanes_q * lanes_q)
+
+    ctx = TrainContext(module, largs, learner_mesh)
+    params0 = train_res["model"].variables["params"]
+    state = ctx.init_state(params0)
+    fn = build_streaming_fn(
+        venv, module, n_lanes, k_steps, mesh=actor_mesh,
+        use_observe_mask=bool(args.get("observation", False)),
+    )
+    replay = DeviceReplay(venv, module, largs, learner_mesh, n_lanes, slots=512)
+    xfer = RecordTransfer(learner_mesh)
+    cache = PlaneParamCache(actor_mesh)
+    cache.publish(params0, 0)
+
+    key = jax.random.PRNGKey(21)
+    vstate = venv.init(n_lanes, jax.random.PRNGKey(22))
+    hidden = module.initial_state((n_lanes, venv.num_players))
+
+    def rollout():
+        nonlocal vstate, hidden, key
+        _, params = cache.latest()
+        key, sub = jax.random.split(key)
+        vstate, hidden, records = dispatch_serialized(
+            lambda: fn(params, vstate, hidden, sub), actor_mesh
+        )
+        return replay.ingest(xfer(records))
+
+    _note(f"northstar3: prefilling rings ({n_lanes} lanes, "
+          f"{len(devices) - actor_chips}+{actor_chips} learner+actor chips)")
+    t_fill = time.perf_counter()
+    while time.perf_counter() - t_fill < 10 * duration:
+        rollout()
+        if replay.eligible_count() >= largs["batch_size"]:
+            break
+    else:
+        return {
+            "skipped": (
+                f"no sampleable window after {time.perf_counter() - t_fill:.0f}s "
+                f"of ring prefill ({n_lanes} lanes)"
+            )
+        }
+
+    train = replay.train_fn(ctx, fused_steps=fused_steps)
+    state, m = train(state, jax.random.PRNGKey(23), 1e-5)  # warm the train path
+    jax.block_until_ready(m["total"])
+
+    def timed_rollout_window(t_window: float):
+        """Drive the actor loop for ~t_window; (game_steps, busy_s, dt)."""
+        stats, busy = [], 0.0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < t_window or not stats:
+            tb = time.perf_counter()
+            stats.append(rollout())
+            busy += time.perf_counter() - tb
+        jax.block_until_ready(stats[-1]["episodes"])
+        dt = time.perf_counter() - t0
+        fetched = jax.device_get(stats)
+        return sum(int(s["game_steps"]) for s in fetched), busy, dt
+
+    _note("northstar3: actor plane standalone")
+    sa_steps, _, sa_dt = timed_rollout_window(duration / 2)
+    standalone_rate = sa_steps / sa_dt
+
+    _note("northstar3: timing both planes concurrently")
+    stop = threading.Event()
+    prod = {"steps": 0, "episodes": 0, "busy_s": 0.0, "lag_sum": 0.0,
+            "dispatches": 0, "error": None}
+    learner_updates = [0]
+
+    def producer():
+        stats, busy, lags = [], [], []
+        n_window = 0
+        try:
+            while not stop.is_set():
+                tb = time.perf_counter()
+                lags.append(max(0, learner_updates[0] - cache.version))
+                stats.append(rollout())
+                busy.append(time.perf_counter() - tb)
+                if not stop.is_set():  # blocks retired inside the window
+                    n_window = len(stats)
+        except Exception:
+            prod["error"] = traceback.format_exc(limit=3)
+        finally:
+            if stats:
+                jax.block_until_ready(stats[-1]["episodes"])
+            # trim EVERY counter to the measurement window, or the frac/
+            # lag denominators disagree with the steps they pair with
+            # (the final rollout can outlive the learner window on CPU)
+            fetched = jax.device_get(stats[:n_window])
+            prod["steps"] = sum(int(s["game_steps"]) for s in fetched)
+            prod["episodes"] = sum(int(s["episodes"]) for s in fetched)
+            prod["busy_s"] = sum(busy[:n_window])
+            prod["lag_sum"] = float(sum(lags[:n_window]))
+            prod["dispatches"] = n_window
+
+    on_cpu = jax.default_backend() == "cpu"
+    thread = threading.Thread(target=producer, daemon=True)
+    xfer_bytes0 = xfer.bytes_transferred + cache.bytes_transferred
+    updates = 0
+    train_s = 0.0
+    rollout_s_learner = 0.0  # rollout work on the LEARNER thread: none
+    tkey = jax.random.PRNGKey(24)
+    thread.start()
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < duration or updates == 0:
+        tt = time.perf_counter()
+        tkey, sub = jax.random.split(tkey)
+        state, m = train(state, sub, 1e-5)
+        train_s += time.perf_counter() - tt
+        updates += fused_steps
+        learner_updates[0] += fused_steps
+        if learner_updates[0] - cache.version >= param_refresh_updates:
+            cache.publish(state["params"], learner_updates[0])
+        if on_cpu:
+            # hand the learner-plane locks to the producer's ingest (the
+            # same unfair-threading.Lock starvation the trainer's sleep
+            # documents); on TPU dispatch is async and the gap never forms
+            time.sleep(0.005)
+    jax.block_until_ready(m["total"])
+    dt = time.perf_counter() - t0
+    stop.set()
+    thread.join(timeout=120.0)
+    if thread.is_alive() and not prod["error"]:
+        # counters are only written in the producer's finally block — a
+        # wedged rollout dispatch would otherwise report 0 self-play
+        # env-steps/s as if it were a real measurement
+        prod["error"] = "producer thread still running after 120s join timeout"
+    selfplay_rate = prod["steps"] / dt
+    consumed = updates * largs["batch_size"] * largs["forward_steps"] / dt
+    out = {
+        "actor_chips": actor_chips,
+        "learner_chips": len(devices) - actor_chips,
+        "lanes": n_lanes,
+        "k_steps": k_steps,
+        "fused_steps": fused_steps,
+        "batch_size": largs["batch_size"],
+        "param_refresh_updates": param_refresh_updates,
+        "trained_env_steps_per_sec": consumed,
+        "updates_per_sec": updates / dt,
+        "selfplay_env_steps_per_sec": selfplay_rate,
+        "selfplay_standalone_env_steps_per_sec": standalone_rate,
+        # the concurrency proof: ~1.0 means training cost self-play
+        # nothing (true disaggregation); the fused loop's equivalent is
+        # its duty split
+        "selfplay_concurrent_frac": selfplay_rate / standalone_rate
+        if standalone_rate else None,
+        # rollout work on the learner plane's program queue: structurally
+        # zero — the split design's whole point (vs 0.91 fused, round 4)
+        "rollout_time_frac": rollout_s_learner / dt,
+        "learner_train_time_frac": train_s / dt,
+        "actor_busy_frac": prod["busy_s"] / dt,
+        "param_lag_mean": prod["lag_sum"] / max(prod["dispatches"], 1),
+        "xfer_bytes_per_sec": (
+            xfer.bytes_transferred + cache.bytes_transferred - xfer_bytes0
+        ) / dt,
+        "produce_consume_ratio": selfplay_rate / consumed if consumed else None,
+        "per_chip_northstar_frac": selfplay_rate / (3125.0 * len(devices)),
+        "episodes": prod["episodes"],
+        "loss_finite": bool(jax.numpy.isfinite(jax.device_get(m["total"]))),
+    }
+    if prod["error"]:
+        out["rollout_error"] = prod["error"]
+    return out
 
 
 def _geister_device_replay_bench(duration: float):
@@ -1068,13 +1302,15 @@ TRANSFORMER_TPU_OVERRIDES = {"batch_size": 64, "burn_in_steps": 2,
 
 KNOWN_STAGES = (
     "tictactoe", "device-selfplay", "geese-device-selfplay", "geese-gen",
-    "geese-train", "northstar", "northstar2", "geese-bf16", "geister",
-    "geister-device-selfplay", "geister-devreplay", "transformer", "flash",
+    "geese-train", "northstar", "northstar2", "northstar3", "geese-bf16",
+    "geister", "geister-device-selfplay", "geister-devreplay",
+    "transformer", "flash",
 )
 # stages that consume another stage's result (main() gates them on it)
 STAGE_DEPS = {
     "northstar": ("geese-train",),
     "northstar2": ("geese-train",),
+    "northstar3": ("geese-train",),
     "geese-bf16": ("geese-train",),
 }
 
@@ -1370,6 +1606,17 @@ def main() -> None:
         result["extra"]["northstar2_rollout_time_frac"] = round(
             ns2["rollout_time_frac"], 4
         )
+        import jax
+
+        if jax.default_backend() != "cpu":
+            # the loop no longer host-syncs per rollout (satellite fix:
+            # the fused baseline must not be handicapped vs northstar3),
+            # so with async dispatch rollout_s is enqueue time only — the
+            # duty split is exact on CPU but under-reports here; flag it
+            # rather than silently redefining the round-4 headline number
+            result["extra"]["northstar2_rollout_time_frac_note"] = (
+                "async dispatch: host-side enqueue share, not device duty"
+            )
         result["extra"]["northstar2_produce_consume_ratio"] = _sig(
             ns2["produce_consume_ratio"]
         )
@@ -1387,6 +1634,71 @@ def main() -> None:
 
     if gt is not None:
         _run_stage(result, "northstar2", stage_northstar2)
+
+    # 3e. north-star v3: DISAGGREGATED planes — self-play on an actor
+    # mesh, training on a disjoint learner mesh, concurrently (the
+    # Podracer/Sebulba split; needs >= 2 devices).  The fused loop's
+    # rollout_time_frac 0.91 becomes a chip split here.
+    def stage_northstar3():
+        ns3 = _split_plane_northstar_bench(gt, T_TRAIN)
+        if "skipped" in ns3:
+            result["extra"]["northstar3_note"] = ns3["skipped"]
+            return
+        result["extra"]["northstar3_chips"] = (
+            f"{ns3['learner_chips']}L+{ns3['actor_chips']}A"
+        )
+        result["extra"]["northstar3_trained_env_steps_per_sec"] = _sig(
+            ns3["trained_env_steps_per_sec"], 5
+        )
+        result["extra"]["northstar3_selfplay_env_steps_per_sec"] = _sig(
+            ns3["selfplay_env_steps_per_sec"], 5
+        )
+        result["extra"]["northstar3_selfplay_standalone_env_steps_per_sec"] = _sig(
+            ns3["selfplay_standalone_env_steps_per_sec"], 5
+        )
+        result["extra"]["northstar3_selfplay_concurrent_frac"] = _sig(
+            ns3["selfplay_concurrent_frac"]
+        )
+        result["extra"]["northstar3_rollout_time_frac"] = round(
+            ns3["rollout_time_frac"], 4
+        )
+        result["extra"]["northstar3_learner_train_time_frac"] = round(
+            ns3["learner_train_time_frac"], 4
+        )
+        result["extra"]["northstar3_actor_busy_frac"] = round(
+            ns3["actor_busy_frac"], 4
+        )
+        result["extra"]["northstar3_param_lag_mean"] = _sig(
+            ns3["param_lag_mean"]
+        )
+        result["extra"]["northstar3_xfer_bytes_per_sec"] = _sig(
+            ns3["xfer_bytes_per_sec"]
+        )
+        result["extra"]["northstar3_produce_consume_ratio"] = _sig(
+            ns3["produce_consume_ratio"]
+        )
+        result["extra"]["northstar3_per_chip_frac"] = _sig(
+            ns3["per_chip_northstar_frac"]
+        )
+        if gt["flops_per_step"] and peak:
+            # flops_per_step was traced at geese-train's batch size; the
+            # split stage may round the batch down to a learner-dp
+            # multiple, and update FLOPs scale linearly with batch
+            flops = gt["flops_per_step"] * (
+                ns3["batch_size"] / gt["args"]["batch_size"]
+            )
+            result["extra"]["northstar3_train_mfu"] = _sig(
+                flops * ns3["updates_per_sec"] / (peak * ns3["learner_chips"])
+            )
+        if ns3.get("rollout_error"):
+            result["error"] = (result["error"] or "") + (
+                " northstar3-rollout: " + ns3["rollout_error"]
+            )
+        if not ns3["loss_finite"]:
+            result["error"] = (result["error"] or "") + " northstar3: non-finite loss"
+
+    if gt is not None:
+        _run_stage(result, "northstar3", stage_northstar3)
 
     # 3b. bf16 mixed precision (MXU-rate forward/backward, fp32 master
     # weights) on the same store — the compute_dtype knob's headroom

@@ -118,6 +118,20 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     # follows eval.opponent when it is random/rulebase (envs without a
     # rule_based_action_all device twin fall back to random).
     "device_eval_games": 0,
+    # device-plane topology: 'fused' (default) runs self-play and training
+    # time-sliced on ONE mesh; 'split' partitions the devices into a
+    # learner mesh (train_args.mesh over the leading devices) and an actor
+    # mesh (the trailing actor_chips devices) so both planes run at full
+    # duty CONCURRENTLY — params flow actor-ward every
+    # param_refresh_updates learner steps, trajectories learner-ward
+    # (runtime/plane.py).  Needs device_rollout_games > 0 and >= 2 devices
+    "plane": "fused",
+    # devices carved off for the actor plane under plane: split
+    "actor_chips": 1,
+    # learner steps between cross-mesh param refreshes of the actor plane
+    # (plane: split): the actor's params are at most this stale — the
+    # plane_param_lag metric surfaces the realized lag
+    "param_refresh_updates": 8,
     # ring length in steps per lane for device_replay
     "device_replay_slots": 1024,
     # game steps advanced per rollout dispatch in the device_replay loop
@@ -228,6 +242,20 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
             raise ValueError("train_args.device_replay_slots must exceed forward_steps")
         if train["device_replay_k_steps"] < 1:
             raise ValueError("train_args.device_replay_k_steps must be >= 1")
+    if train["plane"] not in ("fused", "split"):
+        raise ValueError(
+            f"train_args.plane={train['plane']!r} not one of ('fused', 'split')"
+        )
+    if int(train["actor_chips"]) < 1:
+        raise ValueError("train_args.actor_chips must be >= 1")
+    if int(train["param_refresh_updates"]) < 1:
+        raise ValueError("train_args.param_refresh_updates must be >= 1")
+    if train["plane"] == "split" and train["device_rollout_games"] <= 0:
+        raise ValueError(
+            "train_args.plane: split needs device_rollout_games > 0 (the "
+            "actor plane generates with the on-device streaming rollout; "
+            "host actors don't occupy a device plane)"
+        )
     # observation: true with device_rollout_games is validated per-env at
     # Learner startup: streaming vector envs with an observe_mask hook
     # (Geister) record observer views; turn-player-only envs must refuse

@@ -1,4 +1,10 @@
-from .mesh import make_mesh, batch_sharding, param_shardings, replicated_sharding
+from .mesh import (
+    make_mesh,
+    split_mesh,
+    batch_sharding,
+    param_shardings,
+    replicated_sharding,
+)
 from .train_step import TrainContext, forward_prediction
 from .distributed import (
     init_distributed,
@@ -9,6 +15,7 @@ from .distributed import (
 
 __all__ = [
     "make_mesh",
+    "split_mesh",
     "batch_sharding",
     "replicated_sharding",
     "param_shardings",

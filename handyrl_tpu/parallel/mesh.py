@@ -24,37 +24,77 @@ from typing import Dict, Optional, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-# Serializes the DISPATCH of multi-device (collective-bearing) programs.
-# Two SPMD programs enqueued concurrently from different host threads —
-# e.g. the sharded train step and the sharded device rollout — can reach
-# the devices in a different order on different devices; XLA's collective
-# rendezvous then waits for a participant that is queued behind the other
-# program and aborts the process ("Expected N threads to join ... only
-# N-1 arrived", reproduced on the 8-device CPU mesh).  Holding this lock
-# across the enqueue (the jitted call returns right after dispatch;
-# execution stays async) gives every device the same program order, which
-# is the documented requirement for concurrent collective programs.
-DISPATCH_LOCK = threading.Lock()
+# Serializes the DISPATCH of multi-device (collective-bearing) programs
+# PER DEVICE.  Two SPMD programs enqueued concurrently from different host
+# threads — e.g. the sharded train step and the sharded device rollout —
+# can reach the devices in a different order on different devices; XLA's
+# collective rendezvous then waits for a participant that is queued behind
+# the other program and aborts the process ("Expected N threads to join
+# ... only N-1 arrived", reproduced on the 8-device CPU mesh).  Holding
+# every participating device's lock across the enqueue (the jitted call
+# returns right after dispatch; execution stays async) gives every device
+# the same program order, which is the documented requirement for
+# concurrent collective programs.
+#
+# The locks are PER DEVICE (not one global lock) so programs on DISJOINT
+# device sets — the split actor/learner planes — dispatch concurrently:
+# they share no device, hence no queue whose order could diverge and no
+# rendezvous either could join.  Overlapping sets share at least one
+# device lock and therefore serialize exactly as before; acquiring in
+# global sorted id order makes the multi-lock acquisition deadlock-free.
+_DEVICE_LOCKS: dict = {}
+_REGISTRY_LOCK = threading.Lock()
 
 
-def dispatch_serialized(call):
+def _locks_for(devices):
+    """The per-device locks covering ``devices``, in canonical order."""
+    keys = sorted({(d.process_index, d.id) for d in devices})
+    with _REGISTRY_LOCK:
+        return [_DEVICE_LOCKS.setdefault(k, threading.Lock()) for k in keys]
+
+
+def dispatch_serialized(call, devices=None):
     """Run ``call`` (which enqueues one multi-device program and returns
-    its async outputs) under DISPATCH_LOCK.
+    its async outputs) holding the dispatch lock of every participating
+    device.
 
-    On TPU the lock covers only the enqueue — hardware per-device queues
+    ``devices`` names the devices the program touches: a ``Mesh``, an
+    iterable of jax devices, or None for ALL local devices (the
+    conservative legacy behavior — serializes with everything).  Disjoint
+    device sets proceed concurrently; any overlap serializes.
+
+    On TPU the locks cover only the enqueue — hardware per-device queues
     then preserve the program order and execution stays async.  On the
-    CPU backend the lock additionally holds until the outputs are READY:
+    CPU backend the locks additionally hold until the outputs are READY:
     virtual devices share one thunk pool, so a collective's rendezvous
-    waiters can pin every pool thread while another in-flight program
-    holds the slot the last participant needs — a liveness failure
-    (XLA aborts after its 40 s rendezvous timeout) reproduced on the
-    8-device CPU mesh whenever the sharded train step and the sharded
-    device rollout ran concurrently."""
-    with DISPATCH_LOCK:
+    waiters can pin every pool thread while another in-flight program on
+    an OVERLAPPING device set holds the slot the last participant needs —
+    a liveness failure (XLA aborts after its 40 s rendezvous timeout)
+    reproduced on the 8-device CPU mesh whenever the sharded train step
+    and the sharded device rollout ran concurrently.  Disjoint-set
+    programs never share a rendezvous, so holding only their own locks
+    keeps them overlapping on CPU too (pinned by
+    tests/test_plane.py::test_disjoint_dispatches_overlap)."""
+    if devices is None:
+        devices = jax.devices()
+    elif isinstance(devices, Mesh):
+        devices = devices.devices.flat
+    locks = _locks_for(devices)
+    held = []
+    try:
+        # acquisition inside the try: an async exception (Ctrl-C) landing
+        # mid-loop must release the locks already held, or every later
+        # dispatch touching those devices deadlocks
+        for lock in locks:
+            lock.acquire()
+            held.append(lock)
         out = call()
         if jax.default_backend() == "cpu":
             jax.block_until_ready(out)
         return out
+    finally:
+        for lock in reversed(held):
+            lock.release()
 
 
 def make_mesh(spec: Optional[Dict[str, int]] = None, devices: Optional[Sequence] = None) -> Mesh:
@@ -83,6 +123,32 @@ def make_mesh(spec: Optional[Dict[str, int]] = None, devices: Optional[Sequence]
     import numpy as np
 
     return Mesh(np.asarray(devices[: math.prod(sizes)]).reshape(sizes), tuple(spec.keys()))
+
+
+def split_mesh(spec: Optional[Dict[str, int]] = None, actor_chips: int = 1,
+               devices: Optional[Sequence] = None):
+    """Partition the device list into disjoint (learner_mesh, actor_mesh).
+
+    The learner plane keeps the PREFIX of the device list (so device 0 —
+    the coordinator / checkpoint owner — stays a learner chip) laid out by
+    ``spec`` exactly as ``make_mesh`` would over that many devices; the
+    actor plane takes the trailing ``actor_chips`` devices as a flat
+    ``{'dp': actor_chips}`` mesh.  With per-device dispatch locks the two
+    planes enqueue programs concurrently — self-play and training at full
+    duty on their own chips (config: ``plane: split`` + ``actor_chips``).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    actor_chips = int(actor_chips)
+    if actor_chips < 1:
+        raise ValueError(f"actor_chips must be >= 1, got {actor_chips}")
+    if actor_chips >= len(devices):
+        raise ValueError(
+            f"plane: split needs at least one learner device: actor_chips "
+            f"{actor_chips} of {len(devices)} devices leaves none"
+        )
+    learner = make_mesh(spec, devices[: len(devices) - actor_chips])
+    actor = make_mesh({"dp": actor_chips}, devices[len(devices) - actor_chips:])
+    return learner, actor
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -492,7 +492,9 @@ class TrainContext:
         # rollout) must reach every device in one order — see
         # mesh.dispatch_serialized
         fn = self._bind(state)
-        return dispatch_serialized(lambda: fn(state, device_batch, jnp.float32(lr)))
+        return dispatch_serialized(
+            lambda: fn(state, device_batch, jnp.float32(lr)), self.mesh
+        )
 
     def put_batches(self, host_batches):
         """Stack k host batches -> one (k, B, ...) device tree, B sharded
@@ -532,7 +534,8 @@ class TrainContext:
                 out_shardings=(ss, self._replicated),
             )
         return dispatch_serialized(
-            lambda: self._train_steps(state, stacked_device_batch, jnp.float32(lr))
+            lambda: self._train_steps(state, stacked_device_batch, jnp.float32(lr)),
+            self.mesh,
         )
 
     def flops_per_step(self, state, device_batch):

@@ -136,9 +136,16 @@ class DeviceEvaluator:
         self.module = module
         self.n_lanes = n_lanes
         self.opponent = opponent
+        # a size-1 mesh gets no sharding, but the dispatch locks must
+        # still cover only ITS device: locking all local devices (the
+        # None legacy scope) would stall a split actor plane for the
+        # whole multi-dispatch eval at every epoch boundary
+        self.mesh = mesh if mesh is not None and mesh.size > 1 else None
+        self._lock_devices = (
+            list(mesh.devices.flat) if mesh is not None else None
+        )
         self._fn = build_eval_stream_fn(
-            venv, module, n_lanes, k_steps, opponent=opponent,
-            mesh=mesh if mesh is not None and mesh.size > 1 else None,
+            venv, module, n_lanes, k_steps, opponent=opponent, mesh=self.mesh,
         )
         # per-lane net seat, round-robin: the batched first/second balance
         self._net_seat = jnp.arange(n_lanes, dtype=jnp.int32) % venv.num_players
@@ -161,7 +168,8 @@ class DeviceEvaluator:
         for _ in range(max_calls):
             key, sub = jax.random.split(key)
             state, hidden, rec = dispatch_serialized(
-                lambda: self._fn(params, state, hidden, net_seat, sub)
+                lambda: self._fn(params, state, hidden, net_seat, sub),
+                self._lock_devices,
             )
             done = np.asarray(jax.device_get(rec["done"]))       # (K, B)
             outcome = np.asarray(jax.device_get(rec["outcome"]))  # (K, B, P)

@@ -287,11 +287,14 @@ class DeviceReplay:
         """Fold a (K, B, ...) record batch (one streaming-fn call) into the
         rings.  Returns device-scalar stats (fetch lazily/rarely).
 
-        The ring swap happens INSIDE the dispatch lock: ingest donates the
-        old ring buffers the moment it dispatches, so a concurrent train
-        dispatch must never read ``self.rings`` between the two — both
-        paths read/replace it under DISPATCH_LOCK (train_fn reads it
-        inside its locked lambda the same way)."""
+        The ring swap happens INSIDE the dispatch locks: ingest donates
+        the old ring buffers the moment it dispatches, so a concurrent
+        train dispatch must never read ``self.rings`` between the two —
+        both paths read/replace it under this mesh's per-device dispatch
+        locks (train_fn reads it inside its locked lambda the same way).
+        The contract is PER PLANE: ingest and train both run on this
+        replay's mesh, so a split-plane actor mesh's rollout dispatches
+        never contend with it."""
         if self.rings is None:
             spec = tree_map(lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), records)
             self.rings, _ = self._init_rings(spec)
@@ -308,7 +311,7 @@ class DeviceReplay:
             self._pending = stats
             return stats
 
-        return dispatch_serialized(_run)
+        return dispatch_serialized(_run, self.mesh)
 
     def ingest_counted(self, records) -> Dict[str, float]:
         """ingest + synchronous host fetch of the stats, accumulated into
@@ -364,8 +367,8 @@ class DeviceReplay:
         ``fused_steps`` sample+SGD updates from the CURRENT rings in ONE
         dispatch (metrics summed, matching TrainContext.train_steps).  The
         state layout is pinned on both sides like TrainContext._bind; the
-        rings are read under DISPATCH_LOCK (see ingest) so a concurrent
-        ingest can never hand the train step donated buffers."""
+        rings are read under this mesh's dispatch locks (see ingest) so a
+        concurrent ingest can never hand the train step donated buffers."""
         if fused_steps in self._train_fns:
             return self._train_fns[fused_steps]
         from ..parallel.mesh import param_shardings
@@ -408,7 +411,8 @@ class DeviceReplay:
 
             # self.rings is read INSIDE the locked lambda — see ingest
             return dispatch_serialized(
-                lambda: holder["fn"](state, self.rings, key, jnp.float32(lr))
+                lambda: holder["fn"](state, self.rings, key, jnp.float32(lr)),
+                self.mesh,
             )
 
         def flops_per_update(state) -> float:

@@ -419,6 +419,10 @@ class StreamingDeviceRollout:
         self.n_lanes = n_lanes
         self.k_steps = k_steps
         self.module = module
+        # mesh (or None): the device set the dispatch locks cover — a
+        # split-plane actor mesh dispatches concurrently with the learner
+        # plane; mesh-less rollouts keep the conservative all-device locks
+        self.mesh = mesh
         self._fn = build_streaming_fn(
             venv, module, n_lanes, k_steps, mesh,
             use_observe_mask=bool(args.get("observation", False)),
@@ -446,11 +450,15 @@ class StreamingDeviceRollout:
             )
         from ..parallel.mesh import dispatch_serialized
 
-        # consistent cross-device program order vs the concurrent train
-        # step (and full serialization on the CPU backend) — the dispatch
-        # is async on TPU, so execution still overlaps the assembly below
+        # consistent cross-device program order vs concurrent programs on
+        # an overlapping device set (and serialization with them on the
+        # CPU backend) — the dispatch is async on TPU, so execution still
+        # overlaps the assembly below; on a split-plane actor mesh the
+        # locks cover only the actor devices, so the learner plane's train
+        # dispatches proceed concurrently
         self._state, self._hidden, record = dispatch_serialized(
-            lambda: self._fn(params, self._state, self._hidden, key)
+            lambda: self._fn(params, self._state, self._hidden, key),
+            self.mesh,
         )
         record, self._pending = self._pending, record
         if record is None:

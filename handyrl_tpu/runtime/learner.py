@@ -117,7 +117,34 @@ class Learner:
         self.results_per_opponent: Dict[int, Dict[str, tuple]] = {}
         self.num_results = 0
 
-        mesh = make_mesh(self.args.get("mesh"))
+        # device-plane topology: 'fused' trains and self-plays time-sliced
+        # on one mesh; 'split' carves disjoint learner/actor meshes so both
+        # planes dispatch concurrently (per-device locks, parallel/mesh.py)
+        self._plane = self.args.get("plane", "fused")
+        self._actor_mesh = None
+        self._param_cache = None       # versioned params on the actor mesh
+        self._record_xfer = None       # actor -> learner record transfer
+        self._plane_stats = None
+        self._plane_stats0: Dict[str, float] = {}
+        if self._plane == "split":
+            from ..parallel import split_mesh
+
+            mesh, self._actor_mesh = split_mesh(
+                self.args.get("mesh"), int(self.args["actor_chips"])
+            )
+            print(
+                "device planes: split — learner %s on devices %s, actor "
+                "{'dp': %d} on devices %s (param refresh every %d updates)"
+                % (
+                    dict(mesh.shape),
+                    [d.id for d in mesh.devices.flat],
+                    self._actor_mesh.size,
+                    [d.id for d in self._actor_mesh.devices.flat],
+                    int(self.args["param_refresh_updates"]),
+                )
+            )
+        else:
+            mesh = make_mesh(self.args.get("mesh"))
         self.trainer = Trainer(self.args, self.module, params, mesh)
         # the CONFIGURED assembly plane (start() hasn't run yet, so an shm
         # pipeline could still fall back to threads); metrics records read
@@ -175,6 +202,11 @@ class Learner:
         self._next_update_episodes = (
             self.args["minimum_episodes"] + self.args["update_episodes"]
         )
+        if self._plane == "split" and self._device_games <= 0:
+            raise ValueError(
+                "plane: split needs device_rollout_games > 0 (the actor "
+                "plane generates with the on-device streaming rollout)"
+            )
         if self._device_games > 0:
             vector_env = getattr(self.env, "vector_env", None)
             if vector_env is None:
@@ -183,6 +215,25 @@ class Learner:
                     f"{args['env_args'].get('env')} exposes no vector_env()"
                 )
             self._venv = vector_env()
+            if self._plane == "split" and not hasattr(self._venv, "record"):
+                raise ValueError(
+                    "plane: split needs a STREAMING vector env (record/"
+                    "reset_done/step hooks) — the episodic driver runs on "
+                    f"the default device, not the actor mesh; "
+                    f"{getattr(self._venv, '__name__', type(self._venv).__name__)} "
+                    "lacks them"
+                )
+            if (
+                self._actor_mesh is not None
+                and self._device_games % self._actor_mesh.size
+            ):
+                # fail HERE, not as a sharding error inside the rollout
+                # daemon thread — lanes shard over the actor mesh's dp
+                raise ValueError(
+                    f"device_rollout_games {self._device_games} not "
+                    f"divisible by actor_chips {self._actor_mesh.size} "
+                    "(plane: split shards the lanes over the actor mesh)"
+                )
             if self.args["observation"] and not hasattr(self._venv, "observe_mask"):
                 raise ValueError(
                     "device_rollout_games with observation: true requires a "
@@ -202,26 +253,51 @@ class Learner:
                 from .device_rollout import build_streaming_fn
 
                 mesh = self.trainer.ctx.mesh
+                # rings (and the ingest/train donation contract) live on
+                # the LEARNER mesh; under plane: split the rollout program
+                # runs on the actor mesh and its records cross over
                 self._replay = DeviceReplay(
                     self._venv, self.module, self.args, mesh,
                     self._device_games,
                     slots=self.args["device_replay_slots"],
                 )
+                roll_mesh = (
+                    self._actor_mesh
+                    if self._actor_mesh is not None
+                    else (mesh if mesh.size > 1 else None)
+                )
                 self._stream_fn = build_streaming_fn(
                     self._venv, self.module, self._device_games,
                     self.args["device_replay_k_steps"],
-                    mesh=mesh if mesh.size > 1 else None,
+                    mesh=roll_mesh,
                     use_observe_mask=bool(self.args["observation"]),
                 )
                 self.trainer.device_replay = self._replay
                 self._device_roll = None
+                if self._actor_mesh is not None:
+                    from .plane import RecordTransfer
+
+                    self._record_xfer = RecordTransfer(mesh)
             else:
                 from .device_rollout import make_device_rollout
 
                 self._device_roll = make_device_rollout(
                     self._venv, self.module, self.args, self._device_games,
-                    mesh=self.trainer.ctx.mesh,
+                    mesh=self._actor_mesh
+                    if self._actor_mesh is not None
+                    else self.trainer.ctx.mesh,
                 )
+            if self._actor_mesh is not None:
+                from .plane import PlaneParamCache, PlaneStats
+
+                self._param_cache = PlaneParamCache(self._actor_mesh)
+                # version 0 .. steps: the resumed step count keeps publish
+                # versions monotone across restarts
+                self._param_cache.publish(
+                    self.trainer.state["params"], self.trainer.steps
+                )
+                self._plane_stats = PlaneStats()
+                self.trainer.param_cache = self._param_cache
 
         # on-device evaluation (runtime/device_eval.py): batched
         # net-vs-baseline matches at every epoch boundary — the per-epoch
@@ -393,6 +469,25 @@ class Learner:
             record["device_mean_episode_len"] = self._device_epoch_steps / self._device_epoch_eps
             self._device_epoch_eps = 0
             self._device_epoch_steps = 0
+        if self._plane_stats is not None:
+            # per-epoch plane health (diffed cumulative counters): realized
+            # actor-plane duty, mean param staleness at dispatch, and the
+            # cross-mesh transfer rate (records learner-ward + params
+            # actor-ward) — the plane_* keys soaks watch next to pipe_*
+            snap = self._plane_stats.snapshot()
+            snap["xfer_bytes"] = self._param_cache.bytes_transferred + (
+                self._record_xfer.bytes_transferred if self._record_xfer else 0
+            )
+            prev, dt = self._plane_stats0, max(now - self._epoch_t0, 1e-6)
+            diff = lambda k: snap[k] - prev.get(k, 0.0)
+            record["plane_actor_busy_frac"] = round(diff("actor_busy_s") / dt, 4)
+            record["plane_actor_idle_frac"] = round(diff("actor_idle_s") / dt, 4)
+            record["plane_xfer_bytes_per_sec"] = round(diff("xfer_bytes") / dt, 1)
+            if diff("actor_dispatches"):
+                record["plane_param_lag_mean"] = round(
+                    diff("param_lag_sum") / diff("actor_dispatches"), 2
+                )
+            self._plane_stats0 = snap
         self._epoch_t0 = now
         self._epoch_steps0 = steps
         self._epoch_episodes0 = self.num_returned_episodes
@@ -565,13 +660,36 @@ class Learner:
             if hasattr(roll, "drain"):
                 roll.drain()
 
+    def _actor_params(self):
+        """(model_id, params) for the next rollout dispatch: under plane:
+        split the versioned actor-mesh cache (bumping the realized-lag
+        counter), else the model server's epoch snapshot."""
+        if self._param_cache is None:
+            return self.model_server.latest_snapshot()
+        version, params = self._param_cache.latest()
+        self._plane_stats.bump(
+            actor_dispatches=1,
+            param_lag_sum=max(0, self.trainer.steps - version),
+        )
+        return self.model_epoch, params
+
     def _device_replay_inner(self, key) -> None:
         """Streaming rollout -> device-ring ingest; only scalar counters
-        reach the host, reported to the server loop for the books."""
+        reach the host, reported to the server loop for the books.
+
+        Under plane: split the rollout dispatch holds only the ACTOR
+        mesh's locks — it overlaps the learner plane's train dispatches —
+        and the record batch crosses to the learner mesh before ingest
+        (which shares the learner locks with training, preserving the
+        ring donation contract per plane)."""
         import jax
 
         from ..parallel.mesh import dispatch_serialized
 
+        split = self._param_cache is not None
+        roll_mesh = (
+            self._actor_mesh if split else self.trainer.ctx.mesh
+        )
         key, k0 = jax.random.split(key)
         vstate = self._venv.init(self._device_games, k0)
         hidden = self.module.initial_state(
@@ -581,13 +699,23 @@ class Learner:
         while not self.shutdown_flag:
             if self.num_returned_episodes >= self._next_update_episodes:
                 time.sleep(0.02)   # epoch episode budget met: yield the chip
+                if split:
+                    self._plane_stats.bump(actor_idle_s=0.02)
                 continue
-            epoch, params = self.model_server.latest_snapshot()
+            epoch, params = self._actor_params()
+            t_busy = time.perf_counter()
             key, sub = jax.random.split(key)
             vstate, hidden, records = dispatch_serialized(
-                lambda: self._stream_fn(params, vstate, hidden, sub)
+                lambda: self._stream_fn(params, vstate, hidden, sub),
+                roll_mesh,
             )
+            if split:
+                records = self._record_xfer(records)
             stats = self._replay.ingest_counted(records)
+            if split:
+                self._plane_stats.bump(
+                    actor_busy_s=time.perf_counter() - t_busy
+                )
             n = int(stats["episodes"])
             if self.shutdown_flag:
                 return
@@ -622,10 +750,15 @@ class Learner:
         while not self.shutdown_flag:
             if self.num_returned_episodes >= self._next_update_episodes:
                 time.sleep(0.02)
+                if self._plane_stats is not None:
+                    self._plane_stats.bump(actor_idle_s=0.02)
                 continue
-            epoch, params = self.model_server.latest_snapshot()
+            epoch, params = self._actor_params()
+            t_busy = time.perf_counter()
             key, sub = jax.random.split(key)
             episodes = roll.generate(params, sub)
+            if self._plane_stats is not None:
+                self._plane_stats.bump(actor_busy_s=time.perf_counter() - t_busy)
             for ep in episodes:
                 ep["args"]["model_id"] = {p: epoch for p in ep["players"]}
             if self.shutdown_flag:

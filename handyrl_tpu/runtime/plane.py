@@ -1,0 +1,140 @@
+"""Split actor/learner device planes: cross-mesh param + record flow.
+
+The fused north-star loop is production-bound by construction: one
+self-play env-step costs ~100x one trained env-step in device time, so a
+single program queue spends >90% of its time in rollout however the duty
+cycle is tuned (round-4 sweep, bench.py northstar2).  The Podracer/
+Sebulba answer (Hessel et al. 2021; IMPALA, Espeholt et al. 2018) is to
+stop time-slicing: pin self-play to an **actor mesh** and training to a
+disjoint **learner mesh** (parallel/mesh.py:split_mesh) so both planes
+run at full duty concurrently — made safe by the per-device dispatch
+locks (disjoint planes share no lock).  Two flows cross the planes:
+
+* params, learner -> actor: ``PlaneParamCache`` holds a versioned
+  replicated copy on the actor mesh, refreshed by a cross-mesh
+  ``device_put`` every ``param_refresh_updates`` learner steps; staleness
+  is the ``plane_param_lag`` metric (actor params are at most that many
+  updates behind — the same staleness the IMPALA off-policy corrections
+  in ops/losses.py absorb).
+* trajectories, actor -> learner: ``transfer_records`` re-lays a
+  streaming rollout's (K, B, ...) record batch out on the learner mesh so
+  DeviceReplay (whose rings — and donation-safety contract — live on the
+  learner plane) can ingest it.
+
+Both directions count bytes so metrics.jsonl can report the cross-mesh
+transfer rate (``plane_xfer_bytes_per_sec``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+def _tree_bytes(tree) -> int:
+    return sum(x.nbytes for x in jax.tree.leaves(tree))
+
+
+class PlaneParamCache:
+    """Versioned replicated param copy on the actor mesh.
+
+    The learner thread calls ``publish(params, version)`` between train
+    dispatches (the params are the just-returned state's — still valid;
+    the copy dispatched here holds its own buffer reference, so the next
+    step's donation cannot pull it out from under the transfer).  The
+    actor thread reads ``latest()`` each rollout dispatch.  Versions are
+    learner step counts and must advance monotonically — pinned by
+    tests/test_plane.py.
+    """
+
+    def __init__(self, actor_mesh):
+        self.mesh = actor_mesh
+        self._sharding = NamedSharding(actor_mesh, PartitionSpec())
+        self._lock = threading.Lock()
+        self._params = None
+        self.version = -1
+        self.refreshes = 0
+        self.bytes_transferred = 0
+
+    def publish(self, params, version: int) -> None:
+        """Cross-mesh copy of ``params`` onto the actor mesh (replicated),
+        stamped ``version``.  Monotonicity is enforced: the planes'
+        staleness accounting is meaningless if versions can rewind."""
+        version = int(version)
+        with self._lock:
+            if version <= self.version:
+                raise ValueError(
+                    f"param version must advance monotonically: "
+                    f"{version} <= {self.version}"
+                )
+            # the device_put stays under the lock so a concurrent publisher
+            # cannot interleave between check and store (the dispatch is
+            # async — latest() readers block only for the enqueue)
+            fresh = jax.device_put(params, self._sharding)
+            self._params = fresh
+            self.version = version
+            self.refreshes += 1
+            self.bytes_transferred += _tree_bytes(fresh)
+
+    def latest(self) -> Tuple[int, Any]:
+        """(version, actor-mesh params) of the newest published copy."""
+        with self._lock:
+            if self._params is None:
+                raise RuntimeError("PlaneParamCache.latest() before first publish")
+            return self.version, self._params
+
+    def lag(self, learner_steps: int) -> int:
+        """How many learner updates behind the actor plane's params are."""
+        return max(0, int(learner_steps) - self.version) if self.refreshes else 0
+
+
+class RecordTransfer:
+    """Actor -> learner record re-layout with byte accounting.
+
+    A streaming rollout's (K, B, ...) record batch lives lane-sharded on
+    the actor mesh; DeviceReplay's ingest program runs on the learner
+    mesh and its jit pins ``in_shardings`` there, so the batch must move
+    first.  ``device_put`` to the learner sharding is that move (host
+    round-trip on CPU, direct transfer where the runtime supports it);
+    the dispatch needs NO plane lock — a copy is not a collective-bearing
+    program, so it cannot perturb either plane's program order.
+    """
+
+    def __init__(self, learner_mesh):
+        self.mesh = learner_mesh
+        self._sharding = NamedSharding(learner_mesh, PartitionSpec(None, "dp"))
+        self.transfers = 0
+        self.bytes_transferred = 0
+
+    def __call__(self, records: Dict[str, Any]) -> Dict[str, Any]:
+        moved = jax.device_put(records, self._sharding)
+        self.transfers += 1
+        self.bytes_transferred += _tree_bytes(moved)
+        return moved
+
+
+class PlaneStats:
+    """Shared cumulative counters for the split-plane loop, read (and
+    diffed per epoch) by the learner's metrics record.  All writers hold
+    the lock; snapshot() returns a plain dict."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c: Dict[str, float] = {
+            "actor_dispatches": 0.0,
+            "actor_busy_s": 0.0,     # inside rollout dispatch + ingest
+            "actor_idle_s": 0.0,     # backpressure sleeps / server waits
+            "param_lag_sum": 0.0,    # summed over rollout dispatches
+        }
+
+    def bump(self, **kv: float) -> None:
+        with self._lock:
+            for k, v in kv.items():
+                self._c[k] += v
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._c)

@@ -267,6 +267,12 @@ class Trainer:
         self.device_replay = None
         self._replay_key = jax.random.PRNGKey(args["seed"] ^ 0x7EA1)
 
+        # split-plane param flow (runtime/plane.py): set by the Learner
+        # under plane: split — the SGD loop then pushes a versioned param
+        # copy to the actor mesh every param_refresh_updates steps
+        self.param_cache = None
+        self.param_refresh = max(1, int(args.get("param_refresh_updates", 8)))
+
         self.default_lr = 3e-8 * args["lr_scale"]
         self.data_cnt_ema = args["batch_size"] * args["forward_steps"]
         # FLOPs of one SGD update, resolved once at the end of the first
@@ -355,6 +361,17 @@ class Trainer:
             return self.device_replay.counters["episodes"] >= self.args["minimum_episodes"]
         return len(self.store) >= self.args["minimum_episodes"]
 
+    def _maybe_publish_params(self) -> None:
+        """Split plane only: push a versioned replicated param copy onto
+        the actor mesh once param_refresh_updates steps have passed since
+        the last publish.  Runs on the SGD thread between dispatches, so
+        ``self.state["params"]`` is the just-returned state's — valid
+        until the NEXT train step donates it, and the cross-mesh copy
+        dispatched here holds its own buffer reference."""
+        cache = self.param_cache
+        if cache is not None and self.steps - cache.version >= self.param_refresh:
+            cache.publish(self.state["params"], self.steps)
+
     def train_epoch(self) -> Any:
         """Train until the learner flags an epoch end; return param snapshot."""
         batch_cnt, data_cnt = 0, 0
@@ -380,6 +397,7 @@ class Trainer:
                 metric_accum.append(metrics)
                 batch_cnt += fused
                 self.steps += fused
+                self._maybe_publish_params()
                 data_cnt = 1
                 if on_cpu:
                     # On the CPU backend dispatch_serialized blocks INSIDE
@@ -406,6 +424,7 @@ class Trainer:
                 metric_accum.append(metrics)
                 batch_cnt += fused
                 self.steps += fused
+                self._maybe_publish_params()
                 data_cnt = 1  # real count resolved below without device sync per step
         if not metric_accum:
             return self.state_host["params"]
@@ -424,6 +443,11 @@ class Trainer:
             "train_steps_per_sec": batch_cnt / elapsed,
             "input_wait_frac": wait_s / elapsed,
         }
+        if self.param_cache is not None:
+            # realized actor-plane staleness at the boundary (cumulative
+            # refresh count rides along so soaks can spot a stalled flow)
+            self.stats["plane_param_lag"] = self.param_cache.lag(self.steps)
+            self.stats["plane_param_refreshes"] = self.param_cache.refreshes
         if self.device_replay is None:
             # per-epoch pipeline stage breakdown (cumulative counters
             # diffed against the previous epoch's snapshot) — attributes
@@ -480,8 +504,9 @@ class Trainer:
                 if self.fused > 1:
                     # stacked (k, B, ...) tree -> one batch of AVALS: a
                     # concrete x[0] slice would dispatch multi-device
-                    # gathers outside DISPATCH_LOCK (the serialized-
-                    # dispatch invariant); the lowering only needs shapes
+                    # gathers outside the per-device dispatch locks (the
+                    # serialized-dispatch invariant, parallel/mesh.py);
+                    # the lowering only needs shapes
                     batch = jax.tree.map(
                         lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype),
                         batch,

@@ -40,4 +40,6 @@ def test_pod_slice_dryrun(n_devices):
     assert re.search(r"compile=[\d.]+s step=\d+ms", line), line
     # the pod-slice-shaped dp x sp transformer stage ran (n%4==0 here)
     assert f"transformer dp={n_devices // 4} sp=4" in line, line
+    # the split actor/learner plane leg ran (half the devices each)
+    assert f"split-plane {n_devices // 2}L+{n_devices // 2}A" in line, line
     print(line)

@@ -398,7 +398,8 @@ def test_device_replay_train_fn_exposes_flops():
 
 def test_flops_per_step_accepts_avals():
     """The fused-path FLOPs resolution hands flops_per_step ShapeDtypeStruct
-    leaves (a concrete slice would dispatch outside DISPATCH_LOCK); the
+    leaves (a concrete slice would dispatch outside the per-device
+    dispatch locks); the
     lowering must accept avals and agree with the concrete-batch count."""
     targs = _args(batch_size=4)
     targs["env"] = {"env": "TicTacToe"}
