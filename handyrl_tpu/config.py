@@ -76,6 +76,32 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     # multiprocessing queue lock with it)
     "batcher_max_restarts": 3,
     "batcher_stall_timeout": 60.0,
+    # --- self-healing run plane (docs/fault_tolerance.md) ---------------
+    # divergence sentinel: finite-checks of loss/grad-norm are fused into
+    # the compiled train step; a bad step's update is SKIPPED (never
+    # applied), and sentinel_rollback_after consecutive bad steps (in-step
+    # nonfinite flags + host-side loss-spike EMA detections) roll the train
+    # state back to the newest VERIFIED manifest checkpoint with re-seeded
+    # sampling RNG.  false = bit-identical pre-sentinel step
+    "sentinel": True,
+    "sentinel_rollback_after": 8,
+    # host EMA spike detector: a step whose |loss|/datum exceeds
+    # sentinel_spike_factor x the EMA counts as bad (PaLM-style loss-spike
+    # handling); the EMA ignores bad steps so divergence can't drag it up
+    "sentinel_spike_factor": 10.0,
+    "sentinel_loss_ema_decay": 0.9,
+    # plane watchdog (device-rollout runs): a rollout thread that dies or
+    # makes no progress for plane_stall_timeout seconds is restarted up to
+    # plane_max_restarts times; past the budget a split-plane run degrades
+    # split -> fused loudly.  plane_param_lag_bound > 0 additionally treats
+    # actor params lagging more than that many updates as a stall (0 = off)
+    "plane_stall_timeout": 120.0,
+    "plane_max_restarts": 2,
+    "plane_param_lag_bound": 0,
+    # preemption-safe drain: on SIGTERM/SIGINT the run stops cleanly,
+    # writes a final manifest-verified checkpoint within this budget, and
+    # exits 75 (EX_TEMPFAIL) so a launcher relaunches with restart_epoch -1
+    "drain_deadline_seconds": 60.0,
     # --- TPU-native additions -------------------------------------------
     "mesh": {"dp": -1},
     # multi-host learner plane (parallel/distributed.py): set
@@ -211,6 +237,23 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError("train_args.batcher_max_restarts must be >= 0")
     if train["batcher_stall_timeout"] <= 0:
         raise ValueError("train_args.batcher_stall_timeout must be > 0")
+    if train["sentinel_rollback_after"] < 1:
+        raise ValueError("train_args.sentinel_rollback_after must be >= 1")
+    if train["sentinel_spike_factor"] <= 1.0:
+        raise ValueError(
+            "train_args.sentinel_spike_factor must be > 1 (a spike is a "
+            "multiple of the loss EMA)"
+        )
+    if not 0.0 < train["sentinel_loss_ema_decay"] < 1.0:
+        raise ValueError("train_args.sentinel_loss_ema_decay must be in (0, 1)")
+    if train["plane_stall_timeout"] <= 0:
+        raise ValueError("train_args.plane_stall_timeout must be > 0")
+    if train["plane_max_restarts"] < 0:
+        raise ValueError("train_args.plane_max_restarts must be >= 0")
+    if train["plane_param_lag_bound"] < 0:
+        raise ValueError("train_args.plane_param_lag_bound must be >= 0 (0 = off)")
+    if train["drain_deadline_seconds"] <= 0:
+        raise ValueError("train_args.drain_deadline_seconds must be > 0")
     if train["worker"]["heartbeat_interval"] < 0:
         raise ValueError("train_args.worker.heartbeat_interval must be >= 0 (0 = off)")
     for key in ("socket_timeout", "entry_timeout"):

@@ -362,16 +362,51 @@ class TrainContext:
             full = {k: losses.get(k, jnp.zeros(())) for k in loss_keys}
             return losses["total"], (full, dcnt)
 
+        # Divergence sentinel (config: sentinel, default on): finite-checks
+        # of the loss, the gradient global-norm, and the lr are FUSED into
+        # the compiled step — the verdict rides back with the existing
+        # metrics (no extra host sync on the happy path), and a bad step's
+        # update is suppressed under lax.cond so a single NaN/inf can never
+        # poison the params or the Adam moments.  The host (runtime/
+        # trainer.py) counts the flags at epoch end (sentinel_skipped_steps)
+        # and escalates a long bad streak to a verified-checkpoint rollback.
+        sentinel = bool(args.get("sentinel", True))
+
         def _step(state, batch, lr):
             (loss, (losses, dcnt)), grads = jax.value_and_grad(_loss_fn, has_aux=True)(
                 state["params"], batch
             )
-            updates, opt_state = self.tx.update(grads, state["opt_state"], state["params"])
-            updates = jax.tree.map(lambda u: -lr * u, updates)
-            params = optax.apply_updates(state["params"], updates)
-            new_state = {"params": params, "opt_state": opt_state, "steps": state["steps"] + 1}
+
+            def _apply(_):
+                updates, opt_state = self.tx.update(
+                    grads, state["opt_state"], state["params"]
+                )
+                updates = jax.tree.map(lambda u: -lr * u, updates)
+                return optax.apply_updates(state["params"], updates), opt_state
+
             metrics = dict(losses)
             metrics["dcnt"] = dcnt
+            if sentinel:
+                gnorm = optax.global_norm(grads)
+                bad = jnp.logical_not(
+                    jnp.isfinite(loss) & jnp.isfinite(gnorm) & jnp.isfinite(lr)
+                )
+                params, opt_state = jax.lax.cond(
+                    bad,
+                    lambda _: (state["params"], state["opt_state"]),
+                    _apply,
+                    operand=None,
+                )
+                # a skipped step contributes nothing to the epoch's loss
+                # averages (a NaN loss summed once would poison them); its
+                # count rides in its own key instead
+                metrics = jax.tree.map(
+                    lambda m: jnp.where(bad, jnp.zeros_like(m), m), metrics
+                )
+                metrics["sentinel_bad"] = bad.astype(jnp.float32)
+            else:
+                params, opt_state = _apply(None)
+            new_state = {"params": params, "opt_state": opt_state, "steps": state["steps"] + 1}
             return new_state, metrics
 
         # sharding follows the data: params/opt_state enter laid out by

@@ -321,11 +321,32 @@ def load_verified_params(
     return load_params(model_path(model_dir, epoch), template)
 
 
+def _newest_verified_recorded(model_dir: str) -> int:
+    """Newest manifest-recorded epoch whose snapshot digest-verifies
+    (0 = none).  Non-strict manifest load: this runs on the GC/save path,
+    where a rotted manifest must not kill a healthy run (load_manifest's
+    contract); the rollback entry points stay strict."""
+    recorded = load_manifest(model_dir)["epochs"]
+    for key in sorted(recorded, key=int, reverse=True):
+        meta = recorded[key].get("files", {}).get(f"{key}.ckpt")
+        if meta is not None and _verify_file(model_path(model_dir, int(key)), meta):
+            return int(key)
+    return 0
+
+
 def gc_snapshots(model_dir: str, keep: int) -> List[int]:
     """Delete epoch snapshots older than the newest ``keep`` (0 = keep
     all), pruning their manifest entries.  Only ``{N}.ckpt`` files are
     touched; latest.ckpt / state.ckpt always survive.  Returns the epochs
-    removed."""
+    removed.
+
+    The newest VERIFIED snapshot is PINNED (never collected) even when it
+    falls outside the retention window: it is the divergence sentinel's
+    rollback target and auto-resume's landing point — if the newest
+    ``keep`` snapshots are all corrupt, collecting the last verified one
+    would turn a one-epoch rollback into a from-scratch restart.  The
+    verification walk is newest-first, so on a healthy directory it costs
+    one digest stream of the just-saved snapshot."""
     if keep <= 0:
         return []
     try:
@@ -336,6 +357,10 @@ def gc_snapshots(model_dir: str, keep: int) -> List[int]:
         int(m.group(1)) for name in names if (m := _EPOCH_CKPT_RE.match(name))
     )
     doomed = epochs[:-keep] if len(epochs) > keep else []
+    if not doomed:
+        return []
+    pinned = _newest_verified_recorded(model_dir)
+    doomed = [e for e in doomed if e != pinned]
     if not doomed:
         return []
     for epoch in doomed:

@@ -1,0 +1,74 @@
+"""Env-driven fault injection (``HANDYRL_FAULT_*``) for the self-healing
+run plane — the knobs the sentinel/watchdog/drain e2e tests turn
+(tests/test_sentinel.py, marker ``sentinel``) so the whole
+skip -> rollback -> degrade -> drain loop is exercisable on the
+4-virtual-device CPU mesh with no real divergence or preemption.
+
+All hooks are parsed lazily at their use site (Trainer / rollout-loop
+entry), never at import time, so an in-process test can set the env var
+right before constructing the Learner.  Unset vars mean no injection; a
+malformed value raises immediately (a typo'd injection silently doing
+nothing would fake a green e2e).
+
+Hooks:
+
+* ``HANDYRL_FAULT_NAN_AT_STEP="N"`` or ``"N:M"`` — poison the learning
+  rate with NaN for absolute SGD steps [N, N+M) (M defaults to 1).  A
+  NaN anywhere in the update chain is exactly what the divergence
+  sentinel's in-step finite-check must catch: with ``sentinel: true``
+  the steps are skipped and params stay finite; with ``sentinel: false``
+  the params are poisoned forever (the pre-sentinel failure mode).
+* ``HANDYRL_FAULT_WEDGE_ROLLOUT="N"`` or ``"N:all"`` — after N
+  successful rollout dispatches the device-rollout thread stops making
+  progress (it idles without heartbeating, simulating a wedged XLA
+  execute).  Bare ``N`` wedges only the FIRST thread generation, so a
+  watchdog restart heals the run; ``N:all`` wedges every generation, so
+  the restart budget burns down and a split-plane run must degrade to
+  fused.
+* ``HANDYRL_FAULT_SIGTERM_AT_STEP="N"`` — the trainer delivers SIGTERM
+  to its own process once the step counter reaches N (mid-epoch, the
+  way a TPU-VM preemption lands), driving the preemption-safe drain.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+
+def _get(name: str) -> Optional[str]:
+    raw = os.environ.get(name, "").strip()
+    return raw or None
+
+
+def nan_window() -> Optional[Tuple[int, int]]:
+    """(first_step, n_steps) to poison with a NaN lr, or None."""
+    raw = _get("HANDYRL_FAULT_NAN_AT_STEP")
+    if raw is None:
+        return None
+    if ":" in raw:
+        start, count = raw.split(":", 1)
+        return int(start), max(1, int(count))
+    return int(raw), 1
+
+
+def wedge_rollout() -> Optional[Tuple[int, bool]]:
+    """(after_n_dispatches, every_generation) for the rollout wedge, or
+    None.  ``every_generation`` False wedges only generation 1."""
+    raw = _get("HANDYRL_FAULT_WEDGE_ROLLOUT")
+    if raw is None:
+        return None
+    if ":" in raw:
+        after, scope = raw.split(":", 1)
+        if scope != "all":
+            raise ValueError(
+                f"HANDYRL_FAULT_WEDGE_ROLLOUT={raw!r}: expected 'N' or 'N:all'"
+            )
+        return int(after), True
+    return int(raw), False
+
+
+def sigterm_at_step() -> Optional[int]:
+    """Absolute SGD step at which the trainer SIGTERMs its own process."""
+    raw = _get("HANDYRL_FAULT_SIGTERM_AT_STEP")
+    return None if raw is None else int(raw)

@@ -25,6 +25,8 @@ import json
 import os
 import queue
 import random
+import signal
+import sys
 import threading
 import time
 from concurrent.futures import Future
@@ -34,6 +36,7 @@ from typing import Any, Dict, List, Optional
 from ..envs import make_env, prepare_env
 from ..models import init_variables
 from ..parallel import is_coordinator, make_mesh
+from . import faults
 from .checkpoint import (
     gc_snapshots,
     latest_verified_epoch,
@@ -43,6 +46,21 @@ from .checkpoint import (
 )
 from .trainer import Trainer
 from .worker import LocalModelServer, LocalWorkerPool
+
+# Exit status after a preemption-safe drain (SIGTERM/SIGINT): the run
+# stopped with a VERIFIED resume point on disk and wants to be relaunched
+# with ``restart_epoch: -1``.  75 = BSD EX_TEMPFAIL ("temporary failure,
+# retry"), the conventional please-reschedule-me code supervisors honor.
+EXIT_RESUMABLE = 75
+
+# cumulative plane-watchdog event counters in metrics.jsonl (same
+# convention as pipe_batcher_* / sentinel_*: rare events diffed per epoch
+# would mostly print zeros)
+WATCHDOG_EVENT_KEYS = (
+    "plane_watchdog_stalls",
+    "plane_watchdog_restarts",
+    "plane_watchdog_degraded",
+)
 
 
 class Learner:
@@ -189,6 +207,29 @@ class Learner:
         self._epoch_steps0 = self.trainer.steps  # nonzero after a resume
         self._epoch_episodes0 = 0
         self._trainer_thread: Optional[threading.Thread] = None
+
+        # -- preemption-safe drain (docs/fault_tolerance.md) --------------
+        # SIGTERM (how TPU VMs are preempted) / SIGINT install a stop flag:
+        # the pipelines drain, a final manifest-verified checkpoint lands
+        # under drain_deadline_seconds, and run() returns EXIT_RESUMABLE so
+        # the launcher relaunches with restart_epoch: -1.
+        self.drain_deadline = float(self.args.get("drain_deadline_seconds", 60.0))
+        self._drain_requested = False
+        self._drain_t0 = 0.0
+        self._drain_stopped = False     # trainer.stop() issued for the drain
+        self._prev_handlers: Dict[int, Any] = {}
+
+        # -- plane watchdog ------------------------------------------------
+        # Liveness supervision of the device-rollout plane: a rollout
+        # thread that dies or stops making progress for plane_stall_timeout
+        # (or actor params lagging past plane_param_lag_bound) is restarted
+        # up to plane_max_restarts times; past the budget a split-plane run
+        # degrades split -> fused LOUDLY (the shm-batcher degrade pattern).
+        self._rollout_thread: Optional[threading.Thread] = None
+        self._rollout_gen = 0           # generation token: stale loops exit
+        self._rollout_progress_t = time.monotonic()
+        self._watchdog_events: Dict[str, int] = {k: 0 for k in WATCHDOG_EVENT_KEYS}
+        self._fault_wedge = faults.wedge_rollout()
 
         # fully on-device self-play (runtime/device_rollout.py): env
         # stepping + inference + sampling in one jit call per batch of
@@ -416,7 +457,11 @@ class Learner:
                 print(f"device eval failed: {type(exc).__name__}: {exc}")
 
         if self.model_epoch not in self.results:
-            print("win rate = Nan (0)")
+            # no eval results this epoch: an explicit null record (tooling
+            # can chart the gap) instead of the old misspelled "Nan" stdout
+            # placeholder no parser ever matched
+            print("win rate = n/a (0 games)")
+            record["win_rate"] = None
         else:
             def output_wp(name, stats):
                 wr, n = self._win_rate(stats)
@@ -433,7 +478,8 @@ class Learner:
                     output_wp(key, per_opp[key])
 
         if self.model_epoch not in self.generation_results:
-            print("generation stats = Nan (0)")
+            print("generation stats = n/a (0 episodes)")
+            record["generation_mean"] = None
         else:
             n, r, r2 = self.generation_results[self.model_epoch]
             mean = r / (n + 1e-6)
@@ -469,14 +515,26 @@ class Learner:
             record["device_mean_episode_len"] = self._device_epoch_steps / self._device_epoch_eps
             self._device_epoch_eps = 0
             self._device_epoch_steps = 0
-        if self._plane_stats is not None:
+        if self._device_games > 0:
+            # live plane topology (flips split -> fused after a watchdog
+            # degradation) + cumulative watchdog events
+            record["plane"] = self._plane
+            record.update(self._watchdog_events)
+        # local refs: a concurrent watchdog degrade nulls these attributes
+        # between the None-check and the reads (same hazard as
+        # _actor_params) — the epoch record must not die on the very
+        # degrade it is reporting
+        plane_stats = self._plane_stats
+        param_cache = self._param_cache
+        record_xfer = self._record_xfer
+        if plane_stats is not None and param_cache is not None:
             # per-epoch plane health (diffed cumulative counters): realized
             # actor-plane duty, mean param staleness at dispatch, and the
             # cross-mesh transfer rate (records learner-ward + params
             # actor-ward) — the plane_* keys soaks watch next to pipe_*
-            snap = self._plane_stats.snapshot()
-            snap["xfer_bytes"] = self._param_cache.bytes_transferred + (
-                self._record_xfer.bytes_transferred if self._record_xfer else 0
+            snap = plane_stats.snapshot()
+            snap["xfer_bytes"] = param_cache.bytes_transferred + (
+                record_xfer.bytes_transferred if record_xfer else 0
             )
             prev, dt = self._plane_stats0, max(now - self._epoch_t0, 1e-6)
             diff = lambda k: snap[k] - prev.get(k, 0.0)
@@ -512,12 +570,54 @@ class Learner:
             gc_snapshots(self.model_dir, int(self.args.get("keep_checkpoints", 0)))
         self.model_server.publish(self.model_epoch, params)
 
+    def _repair_metrics_tail(self, path: str) -> None:
+        """Drop a half-written final line left by a killed run BEFORE the
+        resumed run appends to it: appending onto a truncated tail would
+        glue two records into one mid-file invalid line, which readers
+        rightly refuse (read_metrics only tolerates truncation at the
+        END).  Runs once per process, on the first append."""
+        try:
+            with open(path, "rb+") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size == 0:
+                    return
+                f.seek(-1, os.SEEK_END)
+                if f.read(1) == b"\n":
+                    return
+                back = min(size, 1 << 20)
+                f.seek(size - back)
+                cut = f.read(back).rfind(b"\n")
+                f.truncate(size - back + cut + 1 if cut >= 0 else 0)
+            print(
+                f"[handyrl_tpu] {path}: dropped a truncated final line "
+                "(half-written record from a killed run) before appending",
+                file=sys.stderr,
+            )
+        except OSError:
+            pass  # unreadable/missing file: the append below will surface it
+
     def _write_metrics(self, record: Dict[str, Any]) -> None:
+        """Crash-safe metrics append: ONE write() per record (a single
+        O_APPEND write of under a pipe-buffer's worth lands contiguously),
+        flushed AND fsynced before returning, so a kill at any instant
+        costs at most the final line — and readers tolerate exactly that
+        (utils.metrics.read_metrics skips a truncated tail)."""
         path = self.args.get("metrics_path")
         if not path or not is_coordinator():
             return
+        if not getattr(self, "_metrics_tail_checked", False):
+            self._metrics_tail_checked = True
+            if os.path.exists(path):
+                self._repair_metrics_tail(path)
+        line = json.dumps(record, default=float) + "\n"
         with open(path, "a") as f:
-            f.write(json.dumps(record, default=float) + "\n")
+            f.write(line)
+            f.flush()
+            try:
+                os.fsync(f.fileno())
+            except OSError:
+                pass  # metrics durability is best-effort on exotic mounts
 
     # -- server loop (train.py:540-626) --------------------------------------
 
@@ -549,6 +649,84 @@ class Learner:
             return self.worker.connection_count() > 0
         return self._active_workers > 0
 
+    # -- preemption-safe drain ------------------------------------------------
+
+    def _drain_handler(self, signum, frame) -> None:
+        """SIGTERM/SIGINT: install the stop flag and let the loops drain.
+        Runs on the main thread (the server loop), so it only flips flags;
+        the heavy lifting happens at the next loop iteration.  A second
+        signal while draining is ignored (supervisors often double-tap)."""
+        if self._drain_requested:
+            return
+        self._drain_requested = True
+        self._drain_t0 = time.time()
+        self.shutdown_flag = True
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        print(
+            f"[handyrl_tpu] {name} received: draining (final verified "
+            f"checkpoint within {self.drain_deadline:.0f}s, then exit "
+            f"{EXIT_RESUMABLE} for a restart_epoch: -1 relaunch)",
+            file=sys.stderr,
+        )
+
+    def _install_signal_handlers(self) -> None:
+        """Only the main thread may install handlers; elsewhere (a Learner
+        driven from a test/helper thread) the drain is still reachable by
+        calling _drain_handler directly."""
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._prev_handlers[sig] = signal.signal(sig, self._drain_handler)
+            except (ValueError, OSError):  # embedded interpreters
+                pass
+
+    def _restore_signal_handlers(self) -> None:
+        for sig, prev in self._prev_handlers.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev_handlers = {}
+
+    def _drain_tick(self) -> bool:
+        """Per-iteration drain bookkeeping; True = force the loop to end
+        (deadline exhausted with workers still attached)."""
+        if not self._drain_requested:
+            return False
+        if not self._drain_stopped:
+            self._drain_stopped = True
+            # stop the trainer mid-epoch: its thread snapshots state_host
+            # on the way out, which becomes the drain checkpoint
+            self.trainer.stop()
+        if time.time() - self._drain_t0 > self.drain_deadline:
+            print(
+                "[handyrl_tpu] drain deadline exceeded; forcing shutdown "
+                "(the checkpoint still lands from the last consistent state)",
+                file=sys.stderr,
+            )
+            return True
+        return False
+
+    def _write_drain_checkpoint(self) -> None:
+        """The drain's final durable save: epoch snapshot + state + manifest
+        entry via the same atomic path as every boundary save, so
+        ``restart_epoch: -1`` verifies and resumes it."""
+        if not is_coordinator():
+            return
+        self.model_epoch += 1
+        params, payload, steps = self.trainer.drain_payload(self.model_epoch)
+        save_epoch_snapshot(self.model_dir, self.model_epoch, params, payload, steps)
+        gc_snapshots(self.model_dir, int(self.args.get("keep_checkpoints", 0)))
+        print(
+            f"[handyrl_tpu] drain checkpoint: epoch {self.model_epoch} at "
+            f"step {steps} (manifest-verified; resume with restart_epoch: -1)",
+            file=sys.stderr,
+        )
+
     def server(self) -> None:
         print("started server")
         prev_update_episodes = self.args["minimum_episodes"]
@@ -556,6 +734,8 @@ class Learner:
         self._shutdown_t0 = 0.0
 
         while self._workers_active() or not self.shutdown_flag:
+            if self._drain_tick():
+                break
             if self.shutdown_flag and not self._shutdown_t0:
                 self._shutdown_t0 = time.time()
             try:
@@ -613,7 +793,10 @@ class Learner:
             else:
                 fut.set_result(None)
 
-            if self.num_returned_episodes >= next_update_episodes:
+            if (
+                self.num_returned_episodes >= next_update_episodes
+                and not self._drain_requested  # draining: no new boundary work
+            ):
                 prev_update_episodes = next_update_episodes
                 next_update_episodes = prev_update_episodes + self.args["update_episodes"]
                 self._next_update_episodes = next_update_episodes
@@ -633,47 +816,228 @@ class Learner:
             if not fut.done():
                 fut.set_result(None)
         if self._trainer_thread is not None:
-            self._trainer_thread.join(timeout=30)
+            # under a drain, the join is bounded by what's left of the
+            # deadline (floor 5s) so a wedged trainer can't eat the budget;
+            # the checkpoint then falls back to the last consistent state
+            timeout = 30.0
+            if self._drain_requested:
+                left = self.drain_deadline - (time.time() - self._drain_t0)
+                timeout = max(5.0, min(30.0, left))
+            self._trainer_thread.join(timeout=timeout)
+        if self._drain_requested:
+            self._write_drain_checkpoint()
         print("finished server")
 
-    def _device_rollout_loop(self) -> None:
+    # -- rollout plane: generation-tokened loop + watchdog --------------------
+
+    def _start_rollout_thread(self) -> threading.Thread:
+        """(Re)start the device-rollout thread under a fresh generation
+        token.  A superseded generation exits at its next liveness check
+        (a thread truly wedged inside a dispatch cannot be killed from
+        Python — it is abandoned and its generation invalidated, which is
+        the best any host-side supervisor can do)."""
+        self._rollout_gen += 1
+        gen = self._rollout_gen
+        self._rollout_progress_t = time.monotonic()
+        # stall detection arms only after this generation's FIRST dispatch
+        # completes: the first call pays jit compilation (minutes for a
+        # big model on TPU), and declaring that a stall would burn the
+        # whole restart budget on a healthy warm-up (a thread that DIES
+        # during compile is still caught by the dead-thread check)
+        self._rollout_dispatched = False
+        t = threading.Thread(
+            target=self._device_rollout_loop, args=(gen,), daemon=True,
+            name=f"device-rollout-{gen}",
+        )
+        self._rollout_thread = t
+        t.start()
+        return t
+
+    def _rollout_live(self, gen: int) -> bool:
+        return not self.shutdown_flag and self._rollout_gen == gen
+
+    def _rollout_beat(self) -> None:
+        """Progress heartbeat for the plane watchdog: every dispatch,
+        backpressure sleep, and server patience-wait counts as liveness —
+        only a thread that stops doing ALL of those is stalled."""
+        self._rollout_progress_t = time.monotonic()
+
+    def _maybe_wedge(self, gen: int, dispatches: int) -> bool:
+        """HANDYRL_FAULT_WEDGE_ROLLOUT: after N successful dispatches this
+        generation stops heartbeating (simulating a wedged XLA execute) but
+        politely exits once superseded or shut down.  Returns True when the
+        caller should return."""
+        w = self._fault_wedge
+        if w is None or dispatches < w[0] or (not w[1] and gen != 1):
+            return False
+        print(
+            f"[fault] wedging rollout thread generation {gen} after "
+            f"{dispatches} dispatches (HANDYRL_FAULT_WEDGE_ROLLOUT)",
+            file=sys.stderr,
+        )
+        while self._rollout_live(gen):
+            time.sleep(0.05)  # no _rollout_beat: the watchdog must notice
+        return True
+
+    def _watchdog_loop(self) -> None:
+        """Split/fused plane liveness supervision (runs whenever a device
+        rollout thread exists).  Detects a dead rollout thread, a stalled
+        one (no progress beat within plane_stall_timeout), or actor params
+        lagging past plane_param_lag_bound; restarts the thread up to
+        plane_max_restarts, then degrades split -> fused loudly."""
+        timeout = float(self.args.get("plane_stall_timeout", 120.0))
+        max_restarts = int(self.args.get("plane_max_restarts", 2))
+        lag_bound = int(self.args.get("plane_param_lag_bound", 0))
+        restarts = 0
+        tick = max(0.05, min(1.0, timeout / 4.0))
+        while not self.shutdown_flag:
+            time.sleep(tick)
+            if self.shutdown_flag or self._drain_requested:
+                return
+            thread = self._rollout_thread
+            if thread is None:
+                continue
+            dead = not thread.is_alive()
+            stall_s = time.monotonic() - self._rollout_progress_t
+            # pre-first-dispatch silence is compile time, not a stall
+            stalled = stall_s > timeout and self._rollout_dispatched
+            cache = self._param_cache
+            lagged = (
+                lag_bound > 0
+                and cache is not None
+                and cache.lag(self.trainer.steps) > lag_bound
+            )
+            if not (dead or stalled or lagged):
+                continue
+            reason = (
+                "thread died"
+                if dead
+                else f"no progress for {stall_s:.1f}s (> plane_stall_timeout)"
+                if stalled
+                else f"param lag {cache.lag(self.trainer.steps)} > "
+                f"plane_param_lag_bound {lag_bound}"
+            )
+            self._watchdog_events["plane_watchdog_stalls"] += 1
+            print(
+                f"[handyrl_tpu] plane watchdog: rollout plane unhealthy "
+                f"({reason})",
+                file=sys.stderr,
+            )
+            if restarts < max_restarts:
+                restarts += 1
+                self._watchdog_events["plane_watchdog_restarts"] += 1
+                print(
+                    f"[handyrl_tpu] plane watchdog: restarting rollout "
+                    f"thread ({restarts}/{max_restarts})",
+                    file=sys.stderr,
+                )
+                self._start_rollout_thread()
+            elif self._plane == "split":
+                self._degrade_to_fused()
+            else:
+                print(
+                    "[handyrl_tpu] plane watchdog: restart budget exhausted "
+                    "on the fused plane; giving up on the rollout thread "
+                    "(host actors keep generating if configured)",
+                    file=sys.stderr,
+                )
+                return
+
+    def _degrade_to_fused(self) -> None:
+        """Split -> fused degradation (mirrors the shm-batcher degrade
+        pattern): stop the cross-plane param/record flows, rebuild the
+        rollout program on the LEARNER mesh, and restart the rollout
+        thread there.  Training continues throughout — the learner plane
+        never depended on the actor mesh."""
+        self._rollout_gen += 1  # invalidate any live generation FIRST
+        print(
+            "[handyrl_tpu] plane watchdog: restart budget exhausted; "
+            "degrading split -> fused (rollouts move to the learner mesh; "
+            "cross-plane param/record flows stop)",
+            file=sys.stderr,
+        )
+        self.trainer.param_cache = None
+        self._param_cache = None
+        self._record_xfer = None
+        self._plane_stats = None
+        self._actor_mesh = None
+        self._plane = "fused"
+        self._watchdog_events["plane_watchdog_degraded"] = 1
+        mesh = self.trainer.ctx.mesh
+        try:
+            if self._replay is not None:
+                from .device_rollout import build_streaming_fn
+
+                self._stream_fn = build_streaming_fn(
+                    self._venv, self.module, self._device_games,
+                    self.args["device_replay_k_steps"],
+                    mesh=mesh if mesh.size > 1 else None,
+                    use_observe_mask=bool(self.args["observation"]),
+                )
+            else:
+                from .device_rollout import make_device_rollout
+
+                self._device_roll = make_device_rollout(
+                    self._venv, self.module, self.args, self._device_games,
+                    mesh=mesh,
+                )
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            print(
+                "[handyrl_tpu] plane watchdog: learner-mesh rollout rebuild "
+                "failed (above); device generation stops (training continues "
+                "on already-ingested data / host actors)",
+                file=sys.stderr,
+            )
+            return
+        self._start_rollout_thread()
+
+    def _device_rollout_loop(self, gen: int) -> None:
         """Generate device self-play batches up to each epoch boundary
         (backpressure: pause once the boundary's episode budget is met, so
         the chip alternates between rollouts and train steps instead of
-        flooding the store)."""
+        flooding the store).  ``gen`` is this thread's generation token:
+        the loop exits once the watchdog supersedes it."""
         import jax
 
-        key = jax.random.PRNGKey(self.args["seed"] + 0x5EED)
+        # a restarted generation must not replay the superseded stream
+        key = jax.random.PRNGKey(self.args["seed"] + 0x5EED + 0x1009 * (gen - 1))
         if self._device_roll is None:          # device_replay mode
             try:
-                self._device_replay_inner(key)
+                self._device_replay_inner(key, gen)
             finally:
-                self._replay.drain()
+                if self._rollout_gen == gen:  # superseded: new gen owns it
+                    self._replay.drain()
             return
         roll = self._device_roll
         try:
-            self._device_rollout_inner(roll, key)
+            self._device_rollout_inner(roll, key, gen)
         finally:
             # await the in-flight async dispatch; exiting the process with
             # an XLA execution still running aborts it (see
             # StreamingDeviceRollout.drain)
-            if hasattr(roll, "drain"):
+            if hasattr(roll, "drain") and self._rollout_gen == gen:
                 roll.drain()
 
     def _actor_params(self):
         """(model_id, params) for the next rollout dispatch: under plane:
         split the versioned actor-mesh cache (bumping the realized-lag
         counter), else the model server's epoch snapshot."""
-        if self._param_cache is None:
+        cache = self._param_cache       # local refs: a concurrent watchdog
+        stats = self._plane_stats       # degrade nulls these attributes
+        if cache is None:
             return self.model_server.latest_snapshot()
-        version, params = self._param_cache.latest()
-        self._plane_stats.bump(
-            actor_dispatches=1,
-            param_lag_sum=max(0, self.trainer.steps - version),
-        )
+        version, params = cache.latest()
+        if stats is not None:
+            stats.bump(
+                actor_dispatches=1,
+                param_lag_sum=max(0, self.trainer.steps - version),
+            )
         return self.model_epoch, params
 
-    def _device_replay_inner(self, key) -> None:
+    def _device_replay_inner(self, key, gen: int) -> None:
         """Streaming rollout -> device-ring ingest; only scalar counters
         reach the host, reported to the server loop for the books.
 
@@ -681,7 +1045,11 @@ class Learner:
         mesh's locks — it overlaps the learner plane's train dispatches —
         and the record batch crosses to the learner mesh before ingest
         (which shares the learner locks with training, preserving the
-        ring donation contract per plane)."""
+        ring donation contract per plane).
+
+        Split/fused and the meshes are resolved at ENTRY, so a watchdog
+        restart after a split -> fused degradation re-enters here and
+        picks up the learner-mesh plumbing."""
         import jax
 
         from ..parallel.mesh import dispatch_serialized
@@ -690,18 +1058,27 @@ class Learner:
         roll_mesh = (
             self._actor_mesh if split else self.trainer.ctx.mesh
         )
+        # entry-captured refs: a concurrent watchdog degrade nulls the
+        # attributes, and a late-waking superseded thread must die at its
+        # liveness check, not on a None deref mid-iteration
+        record_xfer = self._record_xfer
+        plane_stats = self._plane_stats
         key, k0 = jax.random.split(key)
         vstate = self._venv.init(self._device_games, k0)
         hidden = self.module.initial_state(
             (self._device_games, self._venv.num_players)
         )
         pending_steps = 0   # game steps from batches that finished 0 episodes
-        while not self.shutdown_flag:
+        dispatches = 0
+        while self._rollout_live(gen):
             if self.num_returned_episodes >= self._next_update_episodes:
                 time.sleep(0.02)   # epoch episode budget met: yield the chip
+                self._rollout_beat()  # backpressure idle is healthy
                 if split:
-                    self._plane_stats.bump(actor_idle_s=0.02)
+                    plane_stats.bump(actor_idle_s=0.02)
                 continue
+            if self._maybe_wedge(gen, dispatches):
+                return
             epoch, params = self._actor_params()
             t_busy = time.perf_counter()
             key, sub = jax.random.split(key)
@@ -710,14 +1087,17 @@ class Learner:
                 roll_mesh,
             )
             if split:
-                records = self._record_xfer(records)
+                records = record_xfer(records)
             stats = self._replay.ingest_counted(records)
+            dispatches += 1
+            self._rollout_dispatched = True  # arms stall detection
+            self._rollout_beat()
             if split:
-                self._plane_stats.bump(
+                plane_stats.bump(
                     actor_busy_s=time.perf_counter() - t_busy
                 )
             n = int(stats["episodes"])
-            if self.shutdown_flag:
+            if not self._rollout_live(gen):
                 return
             pending_steps += int(stats["game_steps"])
             if n == 0:
@@ -738,30 +1118,39 @@ class Learner:
             while not fut.done():
                 try:
                     fut.result(timeout=5.0)
+                    self._rollout_beat()  # served: the wait was the server's
                 except (TimeoutError, FutureTimeoutError):
-                    if self.shutdown_flag:
+                    self._rollout_beat()  # waiting on a busy server ≠ a stall
+                    if not self._rollout_live(gen):
                         return
                 except Exception:
                     return
 
-    def _device_rollout_inner(self, roll, key) -> None:
+    def _device_rollout_inner(self, roll, key, gen: int) -> None:
         import jax
 
-        while not self.shutdown_flag:
+        dispatches = 0
+        while self._rollout_live(gen):
             if self.num_returned_episodes >= self._next_update_episodes:
                 time.sleep(0.02)
+                self._rollout_beat()  # backpressure idle is healthy
                 if self._plane_stats is not None:
                     self._plane_stats.bump(actor_idle_s=0.02)
                 continue
+            if self._maybe_wedge(gen, dispatches):
+                return
             epoch, params = self._actor_params()
             t_busy = time.perf_counter()
             key, sub = jax.random.split(key)
             episodes = roll.generate(params, sub)
+            dispatches += 1
+            self._rollout_dispatched = True  # arms stall detection
+            self._rollout_beat()
             if self._plane_stats is not None:
                 self._plane_stats.bump(actor_busy_s=time.perf_counter() - t_busy)
             for ep in episodes:
                 ep["args"]["model_id"] = {p: epoch for p in ep["players"]}
-            if self.shutdown_flag:
+            if not self._rollout_live(gen):
                 return
             # submit once and wait on the SAME future with a patience loop:
             # the server loop can be busy for minutes at an epoch boundary
@@ -773,36 +1162,54 @@ class Learner:
             while not fut.done():
                 try:
                     fut.result(timeout=5.0)
+                    self._rollout_beat()
                 except (TimeoutError, FutureTimeoutError):
-                    if self.shutdown_flag:
+                    self._rollout_beat()  # waiting on a busy server ≠ a stall
+                    if not self._rollout_live(gen):
                         return  # server draining/exited; nothing to feed
                 except Exception:
                     return
 
-    def run(self) -> None:
-        self._trainer_thread = threading.Thread(target=self.trainer.run, daemon=True)
-        self._trainer_thread.start()
-        self.worker.run()
-        self._active_workers = len(getattr(self.worker, "threads", [])) or self.args["worker"]["num_parallel"]
-        rollout_thread = None
-        if self._device_games > 0:
-            rollout_thread = threading.Thread(
-                target=self._device_rollout_loop, daemon=True
-            )
-            rollout_thread.start()
-        self.server()
-        if rollout_thread is not None:
-            # let an in-flight device call drain: tearing down the
-            # interpreter while a daemon thread is inside an XLA execute
-            # aborts the process (C++ exception at exit)
-            rollout_thread.join(timeout=120)
+    def run(self) -> int:
+        """Run to completion.  Returns 0 on a normal finish, EXIT_RESUMABLE
+        (75) after a preemption-safe drain — callers (train_main) exit with
+        it so the launcher knows a verified resume point is waiting."""
+        self._install_signal_handlers()
+        try:
+            self._trainer_thread = threading.Thread(target=self.trainer.run, daemon=True)
+            self._trainer_thread.start()
+            self.worker.run()
+            self._active_workers = len(getattr(self.worker, "threads", [])) or self.args["worker"]["num_parallel"]
+            if self._device_games > 0:
+                self._start_rollout_thread()
+                threading.Thread(
+                    target=self._watchdog_loop, daemon=True, name="plane-watchdog"
+                ).start()
+            self.server()
+            if self._rollout_thread is not None:
+                # let an in-flight device call drain: tearing down the
+                # interpreter while a daemon thread is inside an XLA execute
+                # aborts the process (C++ exception at exit).  Under a drain
+                # the join is bounded by the remaining deadline.
+                timeout = 120.0
+                if self._drain_requested:
+                    left = self.drain_deadline - (time.time() - self._drain_t0)
+                    timeout = max(5.0, min(120.0, left))
+                self._rollout_thread.join(timeout=timeout)
+        finally:
+            self._restore_signal_handlers()
+        return EXIT_RESUMABLE if self._drain_requested else 0
 
 
 def train_main(args: Dict[str, Any]) -> None:
     learner = Learner(args)
-    learner.run()
+    code = learner.run()
+    if code:
+        sys.exit(code)
 
 
 def train_server_main(args: Dict[str, Any]) -> None:
     learner = Learner(args, remote=True)
-    learner.run()
+    code = learner.run()
+    if code:
+        sys.exit(code)

@@ -28,16 +28,20 @@ the learning rate follows the data-count EMA schedule (train.py:328-332,
 
 from __future__ import annotations
 
+import os
 import queue
+import signal
+import sys
 import threading
 import time
 import traceback
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
 from ..parallel import TrainContext
+from . import faults
 from .batch import make_batch
 from .replay import EpisodeStore
 
@@ -53,6 +57,15 @@ PIPE_STAT_KEYS = ("sample_s", "assemble_s", "free_wait_s", "ready_wait_s", "put_
 # means the assembly plane took a fault, and the per-epoch diff of rare
 # events would mostly print zeros
 PIPE_EVENT_KEYS = ("batcher_deaths", "batcher_restarts", "batcher_fallback")
+
+# divergence-sentinel event counters, CUMULATIVE in metrics.jsonl for the
+# same reason: in-step skips (nonfinite loss/grad-norm/lr), host-detected
+# loss spikes (EMA detector), and verified-checkpoint rollbacks
+SENTINEL_EVENT_KEYS = (
+    "sentinel_skipped_steps",
+    "sentinel_spike_steps",
+    "sentinel_rollbacks",
+)
 
 
 def make_pipeline(args: Dict[str, Any], store: EpisodeStore, ctx: TrainContext,
@@ -273,6 +286,28 @@ class Trainer:
         self.param_cache = None
         self.param_refresh = max(1, int(args.get("param_refresh_updates", 8)))
 
+        # -- divergence sentinel (docs/fault_tolerance.md) ----------------
+        # The compiled step already SKIPPED any step with a nonfinite
+        # loss/grad-norm/lr (parallel/train_step.py) — params can never be
+        # poisoned by a single bad batch.  Host-side, this layer counts the
+        # flags riding back in the epoch's metrics, runs a loss-spike EMA
+        # detector over the same fetched values (PaLM-style: spikes are
+        # expected events, Chowdhery et al. 2022), and escalates a streak of
+        # ``sentinel_rollback_after`` consecutive bad steps to a rollback
+        # onto the newest VERIFIED manifest checkpoint with re-seeded RNG.
+        self.sentinel = bool(args.get("sentinel", True))
+        self.sentinel_rollback_after = int(args.get("sentinel_rollback_after", 8))
+        self._spike_factor = float(args.get("sentinel_spike_factor", 10.0))
+        self._loss_ema_decay = float(args.get("sentinel_loss_ema_decay", 0.9))
+        self._loss_ema: Optional[float] = None
+        self._sentinel_streak = 0
+        self.sentinel_events: Dict[str, int] = {k: 0 for k in SENTINEL_EVENT_KEYS}
+        # env-driven injections (runtime/faults.py): NaN lr window and
+        # self-SIGTERM, parsed here so tests set the env before construction
+        self._fault_nan = faults.nan_window()
+        self._fault_sigterm = faults.sigterm_at_step()
+        self._fault_sigterm_fired = False
+
         self.default_lr = 3e-8 * args["lr_scale"]
         self.data_cnt_ema = args["batch_size"] * args["forward_steps"]
         # FLOPs of one SGD update, resolved once at the end of the first
@@ -292,6 +327,20 @@ class Trainer:
             "epoch": np.int32(epoch),
             "data_cnt_ema": np.float64(self.data_cnt_ema),
         }
+
+    def drain_payload(self, epoch: int):
+        """(params, state_payload, steps) for the preemption-drain
+        checkpoint, all read from ONE ``state_host`` reference: the trainer
+        thread swaps that reference atomically at epoch end, so even if the
+        drain races a swap the three pieces stay mutually consistent
+        (save_payload + params_host read it twice and could straddle)."""
+        host = self.state_host
+        payload = {
+            **host,
+            "epoch": np.int32(epoch),
+            "data_cnt_ema": np.float64(self.data_cnt_ema),
+        }
+        return host["params"], payload, int(host["steps"])
 
     def load_state(self, path: str, expected_epoch: int) -> bool:
         """Resume params + Adam moments + step count + lr EMA from state.ckpt.
@@ -372,6 +421,131 @@ class Trainer:
         if cache is not None and self.steps - cache.version >= self.param_refresh:
             cache.publish(self.state["params"], self.steps)
 
+    def _step_lr(self, lr: float, k: int) -> float:
+        """The lr for the next k-step dispatch, with the NaN fault window
+        applied (HANDYRL_FAULT_NAN_AT_STEP): a NaN anywhere in the update
+        chain is what the in-step sentinel must catch."""
+        w = self._fault_nan
+        if w is not None:
+            start, count = w
+            if self.steps < start + count and self.steps + k > start:
+                return float("nan")
+        return lr
+
+    def _maybe_fault_sigterm(self) -> None:
+        """HANDYRL_FAULT_SIGTERM_AT_STEP: deliver a preemption mid-epoch."""
+        if (
+            self._fault_sigterm is not None
+            and not self._fault_sigterm_fired
+            and self.steps >= self._fault_sigterm
+        ):
+            self._fault_sigterm_fired = True
+            print(
+                f"[fault] SIGTERM at step {self.steps} "
+                "(HANDYRL_FAULT_SIGTERM_AT_STEP)",
+                file=sys.stderr,
+            )
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def _sentinel_account(self, fetched: List[Dict[str, Any]]) -> int:
+        """Epoch-end sentinel bookkeeping over the fetched per-dispatch
+        metrics (no extra device syncs: these values were coming to host
+        anyway).  In-step skip flags and host-detected loss spikes extend
+        one consecutive-bad streak; a clean dispatch resets it.  Skipped
+        and spiked dispatches never feed the EMA — a diverging loss must
+        not drag the detector's baseline up after it.  Returns the number
+        of in-step-SKIPPED steps this epoch (their dcnt was zeroed, so the
+        caller must exclude them from the lr schedule's per-step data-count
+        average too)."""
+        skipped = 0
+        for m in fetched:
+            bad = int(round(float(m.get("sentinel_bad", 0.0))))
+            if bad:
+                skipped += bad
+                self.sentinel_events["sentinel_skipped_steps"] += bad
+                self._sentinel_streak += bad
+                continue
+            dcnt = float(m["dcnt"])
+            if dcnt <= 0:
+                continue
+            loss = abs(float(m["total"])) / dcnt
+            if (
+                self._loss_ema is not None
+                and loss > self._spike_factor * max(self._loss_ema, 1e-8)
+            ):
+                self.sentinel_events["sentinel_spike_steps"] += self.fused
+                self._sentinel_streak += self.fused
+                continue
+            self._sentinel_streak = 0
+            d = self._loss_ema_decay
+            self._loss_ema = (
+                loss if self._loss_ema is None else d * self._loss_ema + (1 - d) * loss
+            )
+        if self._sentinel_streak >= self.sentinel_rollback_after:
+            self._sentinel_rollback()
+        return skipped
+
+    def _sentinel_rollback(self) -> None:
+        """Roll the train state back to the newest VERIFIED manifest
+        checkpoint (PR 2's machinery): params from the snapshot, a fresh
+        optimizer (the moments fed the divergence), the step counter kept
+        MONOTONE (lr schedule, param-cache publish versions and the host
+        books all key off it), and the device-replay sampling RNG
+        re-seeded past the poison window.  No verified snapshot (or a
+        corrupt manifest) keeps the current params — the in-step skip
+        already prevents poisoning, so continuing is safe — and resets
+        the streak so the decision is re-evaluated on fresh evidence."""
+        from ..parallel.mesh import dispatch_serialized
+        from . import checkpoint as ckpt
+
+        self._sentinel_streak = 0
+        self._loss_ema = None
+        model_dir = self.args.get("model_dir", "models")
+        try:
+            epoch = ckpt.latest_verified_epoch(model_dir)
+        except ckpt.CheckpointError as exc:
+            print(
+                f"[sentinel] rollback wanted but the manifest is corrupt "
+                f"({exc}); keeping current params",
+                file=sys.stderr,
+            )
+            return
+        if epoch <= 0:
+            print(
+                "[sentinel] divergence streak hit the rollback threshold "
+                "but no verified snapshot exists yet; keeping current "
+                "params (in-step skips already suppressed the bad updates)",
+                file=sys.stderr,
+            )
+            return
+        params = ckpt.load_verified_params(
+            model_dir, epoch, self.state_host["params"], pre_verified=True
+        )
+        # init_state dispatches multi-device layout programs; mid-run the
+        # rollout thread may be dispatching concurrently, so take the
+        # learner mesh's locks like every other multi-device program
+        state = dispatch_serialized(
+            lambda: self.ctx.init_state(params), self.ctx.mesh
+        )
+        state["steps"] = jax.device_put(
+            np.int32(self.steps), self.ctx._replicated
+        )
+        self.state = state
+        self.state_host = jax.device_get(state)
+        self.sentinel_events["sentinel_rollbacks"] += 1
+        # jump the sampling stream far from the one that fed the poison
+        self._replay_key = jax.random.PRNGKey(
+            (self.args["seed"] ^ 0x7EA1)
+            + 0x9E3779B9 * self.sentinel_events["sentinel_rollbacks"]
+            + self.steps
+        )
+        print(
+            f"[sentinel] rolled back to verified epoch {epoch} after a "
+            f"divergence streak (step counter stays at {self.steps}; "
+            "fresh optimizer; re-seeded sampling RNG)",
+            file=sys.stderr,
+        )
+
     def train_epoch(self) -> Any:
         """Train until the learner flags an epoch end; return param snapshot."""
         batch_cnt, data_cnt = 0, 0
@@ -391,13 +565,14 @@ class Trainer:
                 if self.stop_event.is_set():
                     break
                 self._replay_key, sub = jax.random.split(self._replay_key)
-                self.state, metrics = train(self.state, sub, lr)
+                self.state, metrics = train(self.state, sub, self._step_lr(lr, fused))
                 if metric_accum:
                     jax.block_until_ready(metric_accum[-1]["total"])
                 metric_accum.append(metrics)
                 batch_cnt += fused
                 self.steps += fused
                 self._maybe_publish_params()
+                self._maybe_fault_sigterm()
                 data_cnt = 1
                 if on_cpu:
                     # On the CPU backend dispatch_serialized blocks INSIDE
@@ -417,24 +592,31 @@ class Trainer:
                 if batch is None:  # shutting down
                     break
                 last_batch = batch  # batches aren't donated; safe to re-lower
+                step_lr = self._step_lr(lr, fused)
                 if fused > 1:  # k updates per device call, metrics pre-summed
-                    self.state, metrics = self.ctx.train_steps(self.state, batch, lr)
+                    self.state, metrics = self.ctx.train_steps(self.state, batch, step_lr)
                 else:
-                    self.state, metrics = self.ctx.train_step(self.state, batch, lr)
+                    self.state, metrics = self.ctx.train_step(self.state, batch, step_lr)
                 metric_accum.append(metrics)
                 batch_cnt += fused
                 self.steps += fused
                 self._maybe_publish_params()
+                self._maybe_fault_sigterm()
                 data_cnt = 1  # real count resolved below without device sync per step
         if not metric_accum:
             return self.state_host["params"]
 
         fetched = jax.device_get(metric_accum)
+        skipped_steps = 0
+        if self.sentinel:
+            # skip flags + spike detection + (possibly) rollback — all on
+            # values already fetched for the loss report, no extra syncs
+            skipped_steps = self._sentinel_account(fetched)
         data_cnt = float(sum(m["dcnt"] for m in fetched))
         loss_sum = {
             k: float(sum(m[k] for m in fetched))
             for k in fetched[0]
-            if k != "dcnt"
+            if k not in ("dcnt", "sentinel_bad")
         }
         self.last_loss = {k: v / max(data_cnt, 1) for k, v in loss_sum.items()}
         print("loss = %s" % " ".join(f"{k}:{v:.3f}" for k, v in self.last_loss.items()))
@@ -443,6 +625,11 @@ class Trainer:
             "train_steps_per_sec": batch_cnt / elapsed,
             "input_wait_frac": wait_s / elapsed,
         }
+        if self.sentinel:
+            # cumulative, like pipe_batcher_*: a nonzero value anywhere in
+            # the run means the sentinel fired at some point
+            for key, value in self.sentinel_events.items():
+                self.stats[key] = value
         if self.param_cache is not None:
             # realized actor-plane staleness at the boundary (cumulative
             # refresh count rides along so soaks can spot a stalled flow)
@@ -487,7 +674,15 @@ class Trainer:
                     / (elapsed * peak * self.ctx.mesh.size),
                     6,
                 )
-        self.data_cnt_ema = self.data_cnt_ema * 0.8 + data_cnt / (1e-2 + batch_cnt) * 0.2
+        # skipped steps zeroed their dcnt contribution, so they must not
+        # sit in the divisor either — a NaN spell would otherwise silently
+        # depress the lr schedule's per-step data-count average (an
+        # all-skipped epoch leaves the EMA untouched: no evidence)
+        applied_cnt = batch_cnt - skipped_steps
+        if applied_cnt > 0:
+            self.data_cnt_ema = (
+                self.data_cnt_ema * 0.8 + data_cnt / (1e-2 + applied_cnt) * 0.2
+            )
         self.state_host = jax.device_get(self.state)
         return self.state_host["params"]
 

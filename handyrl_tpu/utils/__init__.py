@@ -1,3 +1,4 @@
+from .metrics import read_metrics
 from .platform import apply_platform_override
 from .tree import (
     tree_map,
@@ -11,6 +12,7 @@ from .tree import (
 
 __all__ = [
     "apply_platform_override",
+    "read_metrics",
     "tree_map",
     "tree_stack",
     "tree_unstack",

@@ -1,0 +1,45 @@
+"""Crash-tolerant metrics.jsonl reading.
+
+The learner appends one JSON record per epoch with a flush+fsync per
+record (runtime/learner.py:_write_metrics), so a SIGKILL / power cut mid-
+append leaves at most ONE half-written line — and only at the tail.  Every
+reader of metrics.jsonl (the plot scripts via scripts/_logparse.py, the
+soak/ablation tools) goes through ``read_metrics`` so that one truncated
+final line is tolerated instead of breaking downstream parsing, while a
+malformed line anywhere ELSE still raises: mid-file corruption is a real
+integrity problem, not an artifact of the append protocol.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def read_metrics(path: str, strict: bool = False) -> List[Dict[str, Any]]:
+    """Parse a metrics.jsonl into a list of records.
+
+    A truncated FINAL line (the one write a kill can interrupt) is skipped
+    with a stderr note unless ``strict``; invalid JSON on any earlier line
+    raises ``ValueError`` regardless.
+    """
+    with open(path) as f:
+        lines = f.readlines()
+    records: List[Dict[str, Any]] = []
+    last = len(lines) - 1
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            if i == last and not strict:
+                print(
+                    f"[handyrl_tpu] {path}: dropping truncated final line "
+                    "(half-written record from a killed run)",
+                    file=sys.stderr,
+                )
+                break
+            raise
+    return records

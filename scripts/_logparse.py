@@ -21,13 +21,31 @@ _EPOCH_RE = re.compile(r"^epoch (?P<epoch>\d+)")
 _UPDATED_RE = re.compile(r"updated model\((?P<steps>\d+)\)")
 
 
+try:  # tolerate a truncated final line (killed run mid-append)
+    from handyrl_tpu.utils.metrics import read_metrics as _read_metrics
+except ImportError:  # standalone script use outside the repo: same logic
+    def _read_metrics(path, strict=False):
+        with open(path) as f:
+            lines = f.readlines()
+        out = []
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                if i == len(lines) - 1 and not strict:
+                    break  # half-written tail from a kill mid-append
+                raise
+        return out
+
+
 def parse_records(path: str) -> List[Dict[str, Any]]:
     """Parse metrics.jsonl or a captured stdout log into epoch records."""
     with open(path) as f:
         first = f.read(1)
     if first == "{":
-        with open(path) as f:
-            return [json.loads(line) for line in f if line.strip()]
+        return _read_metrics(path)
     return _parse_stdout(path)
 
 

@@ -24,7 +24,8 @@ from _logparse import parse_records, save_or_show, smooth
 def main() -> None:
     path = sys.argv[1] if len(sys.argv) >= 2 else "metrics.jsonl"
     out = sys.argv[2] if len(sys.argv) >= 3 else "stats.png"
-    records = [r for r in parse_records(path) if "generation_mean" in r]
+    # None = an epoch with an explicit null record (no episodes returned)
+    records = [r for r in parse_records(path) if r.get("generation_mean") is not None]
     if not records:
         print("no generation-stats records found")
         sys.exit(1)

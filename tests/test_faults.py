@@ -218,6 +218,37 @@ def test_retention_gc_keeps_newest_k_and_prunes_manifest(tmp_path):
     assert cp.gc_snapshots(d, 0) == []
 
 
+def test_gc_never_collects_the_newest_verified_rollback_target(tmp_path):
+    """GC x sentinel-rollback interplay: when every snapshot inside the
+    retention window is corrupt, the newest VERIFIED epoch — the one the
+    divergence sentinel would roll back to, and auto-resume's landing
+    point — is PINNED even though it falls outside ``keep_checkpoints``.
+    Collecting it would turn a one-epoch rollback into a from-scratch
+    restart."""
+    d = str(tmp_path)
+    _seed_snapshots(d, epochs=(1, 2, 3, 4, 5))
+    # the newest two (the whole keep=2 window) rot on disk; the manifest
+    # still records them, so verification is what must save epoch 3
+    for e in (4, 5):
+        with open(cp.model_path(d, e), "r+b") as f:
+            f.write(b"\xff" * 16)
+    assert cp.latest_verified_epoch(d) == 3
+    removed = cp.gc_snapshots(d, 2)
+    # 3 is pinned; the older unverified snapshots still go
+    assert removed == [1, 2]
+    assert os.path.exists(cp.model_path(d, 3))
+    assert "3" in cp.load_manifest(d)["epochs"]
+    # the rollback target still loads verified after the GC pass
+    np.testing.assert_array_equal(
+        cp.load_verified_params(d, 3, _params(0.0))["w"], _params(3.0)["w"]
+    )
+    # healthy directory: the pin is the newest kept snapshot anyway — GC
+    # behavior is unchanged (no extra survivors)
+    d2 = str(tmp_path / "healthy")
+    _seed_snapshots(d2, epochs=(1, 2, 3, 4, 5))
+    assert cp.gc_snapshots(d2, 2) == [1, 2, 3]
+
+
 def test_resume_roundtrip_preserves_adam_moments_and_steps(tmp_path):
     """The trainer contract behind every resume test: params + Adam
     moments + step count + lr EMA round-trip bit-exactly through the

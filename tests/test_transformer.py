@@ -403,6 +403,14 @@ def test_transformer_train_step_tensor_parallel():
         )
 
 
+@pytest.mark.skipif(
+    # environmental, reproduces at the seed commit on this container's
+    # jax 0.4.37: ops/ring_attention.py needs jax.lax.pvary (see the
+    # matching gate in tests/test_parallel.py)
+    not hasattr(jax.lax, "pvary"),
+    reason="jax.lax.pvary unavailable on this jax (< 0.5); "
+    "seq_attention='ring' needs it (seed-reproducing environmental failure)",
+)
 def test_transformer_train_step_ring_sp():
     """seq_attention='ring': the FULL train step on a dp x sp mesh with the
     transformer window sharded across the 'sp' axis — metrics must match

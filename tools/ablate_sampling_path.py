@@ -94,13 +94,15 @@ def run_one(env_name: str, device_path: bool, epochs: int, run_root: str,
     if rc != 0:
         raise SystemExit(f"{env_name}/{tag} train failed rc={rc}; "
                          f"see {run_dir}/train.log")
+    from handyrl_tpu.utils.metrics import read_metrics
+
     curve = []
-    with open(os.path.join(run_dir, "metrics.jsonl")) as f:
-        for line in f:
-            rec = json.loads(line)
-            wr = rec.get("win_rate", {}).get("total")
-            if wr is not None:
-                curve.append({"epoch": rec["epoch"], "win_rate": round(wr, 4)})
+    # read_metrics tolerates a truncated tail; win_rate can be an explicit
+    # null on epochs with no eval results
+    for rec in read_metrics(os.path.join(run_dir, "metrics.jsonl")):
+        wr = (rec.get("win_rate") or {}).get("total")
+        if wr is not None:
+            curve.append({"epoch": rec["epoch"], "win_rate": round(wr, 4)})
     late = [c["win_rate"] for c in curve if c["epoch"] >= epochs * 2 // 3]
     return {
         "path": tag,
