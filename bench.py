@@ -643,6 +643,102 @@ def _pipeline_bench(train_res, duration: float):
     }
 
 
+def _pipeline_scaling_bench(train_res, duration: float):
+    """northstar4: the host-pipeline scaling curve + the host-bypass path,
+    side by side over ONE episode store (ROADMAP item 3).
+
+    BENCH_r05 measured the chip eating 376 direct updates/s while the
+    host-fed pipeline delivered 3.0 — and the shm plane had never been
+    shown to scale past one child.  This stage measures exactly that:
+    the shm plane at num_batchers 1/2/4 (updates/s, input_wait_frac,
+    per-stage breakdown each), then ``batch_pipeline: device`` — episodes
+    uploaded once into device rings, windows assembled on device
+    (runtime/device_batch.py) — and evaluates every point against the
+    direct updates/s from geese-train (target: host-fed >= 50% of direct
+    with input_wait_frac < 0.05).
+    """
+    from handyrl_tpu.runtime.trainer import PIPE_STAT_KEYS, make_pipeline
+
+    args, ctx, store = train_res["args"], train_res["ctx"], train_res["store"]
+    params = train_res["model"].variables["params"]
+    per_point = max(2.0, duration / 2)
+
+    def timed_point(cfg_over):
+        cfg = dict(args, **cfg_over)
+        stop = threading.Event()
+        pipe = make_pipeline(cfg, store, ctx, stop)
+        pipe.start()
+        state = ctx.init_state(params)
+        window = {}
+        n, wait_s, dt = _timed_pipeline_train(
+            pipe, ctx, state, per_point,
+            on_timed_start=lambda: window.update(t0=pipe.stats()),
+            on_timed_end=lambda: window.update(t1=pipe.stats()),
+        )
+        stop.set()
+        pipe.stop()
+        s0, s1 = window.get("t0", {}), window.get("t1", {})
+        return {
+            "updates_per_sec": n / dt,
+            "input_wait_frac": wait_s / dt,
+            "mode": s1.get("mode"),
+            "stages": {
+                key: round(s1.get(key, 0.0) - s0.get(key, 0.0), 4)
+                for key in PIPE_STAT_KEYS
+            },
+        }
+
+    points = {}
+    for nb in (1, 2, 4):
+        _note(f"northstar4: shm plane, num_batchers={nb}")
+        points[f"host_b{nb}"] = timed_point(
+            {"batch_pipeline": "shm", "num_batchers": nb}
+        )
+    # stage geometry sized to the STORE: a chunk flushes only when every
+    # lane has chunk steps queued, so on a small static store the default
+    # lanes x chunk would never become sampleable and batch() would wait
+    # forever (host-generated geese episodes run ~5 steps, not hundreds)
+    total_steps = sum(int(ep["steps"]) for ep in store.snapshot())
+    dp = ctx.mesh.shape.get("dp", 1)
+    # a chunk is INGEST granularity, not window length — windows span
+    # chunks, so it only needs to leave half the store flushable
+    chunk = max(1, min(64, total_steps // (2 * dp)))
+    _note(f"northstar4: host-bypass device stage ({dp} lanes x chunk {chunk})")
+    points["device"] = timed_point({
+        "batch_pipeline": "device",
+        "device_stage_lanes": dp,
+        "device_stage_chunk": chunk,
+        "device_stage_slots": max(
+            int(args.get("device_stage_slots", 1024)), 2 * chunk
+        ),
+    })
+
+    direct = train_res["updates_per_sec"]
+    best_host = max(
+        (k for k in points if k.startswith("host_")),
+        key=lambda k: points[k]["updates_per_sec"],
+    )
+
+    def target_met(p):
+        return bool(
+            direct
+            and p["updates_per_sec"] >= 0.5 * direct
+            and p["input_wait_frac"] < 0.05
+        )
+
+    return {
+        "points": points,
+        "direct_updates_per_sec": direct,
+        "best_host": best_host,
+        "best_host_vs_direct": points[best_host]["updates_per_sec"] / direct
+        if direct else None,
+        "device_vs_direct": points["device"]["updates_per_sec"] / direct
+        if direct else None,
+        "host_target_met": target_met(points[best_host]),
+        "device_target_met": target_met(points["device"]),
+    }
+
+
 def _device_selfplay_bench(duration: float):
     """Fully on-device self-play (runtime/device_rollout.py): env stepping
     + inference + sampling in ONE jit call over thousands of parallel
@@ -1302,8 +1398,8 @@ TRANSFORMER_TPU_OVERRIDES = {"batch_size": 64, "burn_in_steps": 2,
 
 KNOWN_STAGES = (
     "tictactoe", "device-selfplay", "geese-device-selfplay", "geese-gen",
-    "geese-train", "northstar", "northstar2", "northstar3", "geese-bf16",
-    "geister", "geister-device-selfplay", "geister-devreplay",
+    "geese-train", "northstar", "northstar2", "northstar3", "northstar4",
+    "geese-bf16", "geister", "geister-device-selfplay", "geister-devreplay",
     "transformer", "flash",
 )
 # stages that consume another stage's result (main() gates them on it)
@@ -1311,6 +1407,7 @@ STAGE_DEPS = {
     "northstar": ("geese-train",),
     "northstar2": ("geese-train",),
     "northstar3": ("geese-train",),
+    "northstar4": ("geese-train",),
     "geese-bf16": ("geese-train",),
 }
 
@@ -1699,6 +1796,37 @@ def main() -> None:
 
     if gt is not None:
         _run_stage(result, "northstar3", stage_northstar3)
+
+    # 3f. north-star v4: the host-pipeline scaling curve (shm plane at
+    # 1/2/4 batcher processes) + the host-bypass device stage, all fed
+    # from geese-train's store, each judged against the direct updates/s
+    # (ROADMAP item 3: host-fed >= 50% of direct at input_wait < 0.05)
+    def stage_northstar4():
+        ns4 = _pipeline_scaling_bench(gt, T_TRAIN)
+        for name, p in ns4["points"].items():
+            result["extra"][f"northstar4_{name}_updates_per_sec"] = _sig(
+                p["updates_per_sec"]
+            )
+            result["extra"][f"northstar4_{name}_input_wait_frac"] = round(
+                p["input_wait_frac"], 4
+            )
+            result["extra"][f"northstar4_{name}_mode"] = p["mode"]
+            result["extra"][f"northstar4_{name}_stages"] = p["stages"]
+        result["extra"]["northstar4_direct_updates_per_sec"] = _sig(
+            ns4["direct_updates_per_sec"]
+        )
+        result["extra"]["northstar4_best_host"] = ns4["best_host"]
+        result["extra"]["northstar4_best_host_vs_direct"] = _sig(
+            ns4["best_host_vs_direct"]
+        )
+        result["extra"]["northstar4_device_vs_direct"] = _sig(
+            ns4["device_vs_direct"]
+        )
+        result["extra"]["northstar4_host_target_met"] = ns4["host_target_met"]
+        result["extra"]["northstar4_device_target_met"] = ns4["device_target_met"]
+
+    if gt is not None:
+        _run_stage(result, "northstar4", stage_northstar4)
 
     # 3b. bf16 mixed precision (MXU-rate forward/backward, fp32 master
     # weights) on the same store — the compute_dtype knob's headroom

@@ -116,14 +116,27 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     "prefetch_batches": 2,
     # batch-assembly plane: 'shm' (default) forks num_batchers PROCESSES
     # that write columnar batches into shared-memory ring slots — GIL-free,
-    # zero-copy on the consumer side (runtime/shm_batch.py); 'thread' keeps
-    # the in-process threaded batchers (the portable fallback, also used
-    # automatically when the shm plane cannot start)
+    # zero-copy on the consumer side (runtime/shm_batch.py); 'device'
+    # uploads host-born episodes ONCE into device ring buffers and
+    # samples/assembles training windows ON DEVICE (runtime/device_batch.py
+    # + DeviceEpisodeStage — make_batch and the per-update observation H2D
+    # re-upload leave the hot loop; single-process, ff mode needs
+    # turn_based_training: false, turn mode needs observation: true);
+    # 'thread' keeps the in-process threaded batchers (the portable
+    # fallback, also used automatically when a richer plane cannot start)
     "batch_pipeline": "shm",
     # shared-memory ring depth, in slots of one (B, T, P, ...) batch each;
-    # clamped up to fused_steps + 2 so the fused device-put can always
-    # drain a full group while one slot stays in flight
+    # clamped up to 2*fused_steps + 2 so the double-buffered device-put can
+    # keep two fused groups in flight while the children keep filling
     "shm_slots": 6,
+    # batch_pipeline: device geometry — episodes queue over this many ring
+    # lanes (rounded up to a mesh-dp multiple), each slots steps deep, and
+    # upload in (chunk, lanes) blocks.  Keep lanes*chunk well below
+    # minimum_episodes x the typical episode length or the first flush
+    # waits on generation
+    "device_stage_lanes": 8,
+    "device_stage_slots": 1024,
+    "device_stage_chunk": 64,
     # k SGD updates fused under one lax.scan per device call (amortizes
     # per-call dispatch for small models); 1 = one jit call per update.
     # Semantics are identical: lr is already held constant within an epoch.
@@ -206,6 +219,20 @@ DEFAULT_WORKER_ARGS: Dict[str, Any] = {
 VALID_TARGETS = ("MC", "TD", "UPGO", "VTRACE")
 
 
+def effective_shm_slots(train: Dict[str, Any]) -> int:
+    """The ring depth the shm batch plane ACTUALLY allocates: ``shm_slots``
+    clamped up so the double-buffered device-put can keep two fused groups
+    in flight while the children keep filling.  Single source of truth —
+    ``validate_args`` checks ``num_batchers`` against it and
+    ``ShmBatchPipeline`` allocates exactly it; change the consumer's
+    buffering depth in one place only."""
+    return max(
+        int(train.get("shm_slots", 6)),
+        2 * int(train.get("fused_steps", 1)) + 2,
+        3,
+    )
+
+
 def _deep_merge(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
     out = copy.deepcopy(base)
     for key, value in (override or {}).items():
@@ -261,13 +288,57 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
             raise ValueError(f"train_args.worker.{key} must be > 0")
     if train["fused_steps"] < 1:
         raise ValueError("train_args.fused_steps must be >= 1")
-    if train["batch_pipeline"] not in ("shm", "thread"):
+    if train["batch_pipeline"] not in ("shm", "thread", "device"):
         raise ValueError(
             f"train_args.batch_pipeline={train['batch_pipeline']!r} "
-            "not one of ('shm', 'thread')"
+            "not one of ('shm', 'thread', 'device')"
         )
     if int(train["shm_slots"]) < 2:
         raise ValueError("train_args.shm_slots must be >= 2")
+    if int(train["num_batchers"]) < 0:
+        raise ValueError(
+            "train_args.num_batchers must be >= 0 (0 = in-process threaded "
+            "batchers; the shm plane needs at least 1 process)"
+        )
+    # the ring depth the shm plane is GUARANTEED to allocate on every
+    # platform: the runtime may clamp fused_steps down to 1 (multi-device
+    # CPU meshes execute fused scans pathologically — trainer.py), which
+    # shrinks the 2*fused+2 enlargement with it, so only the fused=1 floor
+    # can be promised at config time
+    floor_slots = effective_shm_slots(dict(train, fused_steps=1))
+    if (
+        train["batch_pipeline"] in ("shm", "device")  # device falls back to shm
+        and int(train["num_batchers"]) > floor_slots
+    ):
+        # a child beyond the ring depth would never be dealt a slot: it
+        # spins forever contributing nothing — fail loudly at startup
+        # instead of deep inside shm_batch setup (same spirit as the
+        # plane: split validations)
+        raise ValueError(
+            f"train_args.num_batchers={train['num_batchers']} exceeds the "
+            f"guaranteed shm ring depth {floor_slots} (shm_slots="
+            f"{train['shm_slots']}; fused_steps can be clamped to 1 at "
+            "runtime, so its ring enlargement does not count): each batcher "
+            "process needs at least one ring slot to hold — raise shm_slots "
+            "or lower num_batchers"
+        )
+    if train["batch_pipeline"] == "device":
+        if train["device_replay"]:
+            raise ValueError(
+                "train_args.batch_pipeline: device is redundant under "
+                "device_replay: true (that path never materializes host "
+                "episodes, so there is nothing for the stage to upload)"
+            )
+        if int(train["device_stage_lanes"]) < 1:
+            raise ValueError("train_args.device_stage_lanes must be >= 1")
+        if int(train["device_stage_chunk"]) < 1:
+            raise ValueError("train_args.device_stage_chunk must be >= 1")
+        min_slots = train["burn_in_steps"] + train["forward_steps"]
+        if int(train["device_stage_slots"]) <= min_slots:
+            raise ValueError(
+                "train_args.device_stage_slots must exceed burn_in_steps + "
+                f"forward_steps = {min_slots}"
+            )
     if train["device_rollout_games"] < 0:
         raise ValueError("train_args.device_rollout_games must be >= 0")
     if train["device_eval_games"] < 0:

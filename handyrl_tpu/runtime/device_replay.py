@@ -73,7 +73,8 @@ Two window modes (checked at construction, dispatched by
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from collections import deque
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -185,6 +186,10 @@ class DeviceReplay:
             "episodes": 0, "game_steps": 0, "player_steps": 0,
             "outcome_sum": 0.0, "outcome_sq_sum": 0.0,
         }
+        # deferred-stats FIFO (ingest_counted(defer=True)): device scalar
+        # handles whose host fetch is postponed one dispatch so it overlaps
+        # the ingest's execution instead of synchronizing on it
+        self._stats_fifo: deque = deque()
 
     # -- ring construction --------------------------------------------------
 
@@ -313,18 +318,57 @@ class DeviceReplay:
 
         return dispatch_serialized(_run, self.mesh)
 
-    def ingest_counted(self, records) -> Dict[str, float]:
-        """ingest + synchronous host fetch of the stats, accumulated into
-        ``self.counters`` — the learner-integration path, which needs
-        episode counts for epoch cadence anyway (one scalar fetch per
-        k_steps-sized rollout call)."""
-        stats = tree_map(np.asarray, jax.device_get(self.ingest(records)))
+    def _account(self, dev_stats) -> Dict[str, Any]:
+        """Host-fetch one ingest's stats and fold them into the cumulative
+        counters (blocks until that ingest has executed)."""
+        stats = tree_map(np.asarray, jax.device_get(dev_stats))
         self.counters["episodes"] += int(stats["episodes"])
         self.counters["game_steps"] += int(stats["game_steps"])
         self.counters["player_steps"] += int(stats["player_steps"])
         self.counters["outcome_sum"] += float(stats["outcome_sum"].sum())
         self.counters["outcome_sq_sum"] += float(stats["outcome_sq_sum"])
         return stats
+
+    def ingest_counted(self, records, defer: bool = False):
+        """ingest + host fetch of the stats, accumulated into
+        ``self.counters`` — the learner-integration path, which needs
+        episode counts for epoch cadence anyway.
+
+        ``defer=False`` fetches synchronously (one blocking scalar fetch
+        per rollout-sized call — fine for prefill loops and tests).
+        ``defer=True`` removes that last host round-trip from the hot
+        path: the fetch of dispatch N happens only after dispatch N+1 has
+        been enqueued, so it overlaps ingest N+1's execution instead of
+        serializing the rollout thread on every ingest.  Returns the
+        PREVIOUS dispatch's stats (None on the first call); callers drain
+        the tail with ``flush_counted``.  Counter totals are identical
+        either way (pinned by tests/test_device_replay.py)."""
+        dev = self.ingest(records)
+        if not defer:
+            return self._account(dev)
+        self._stats_fifo.append(dev)
+        if len(self._stats_fifo) < 2:
+            return None
+        return self._account(self._stats_fifo.popleft())
+
+    def flush_counted(self) -> Optional[Dict[str, float]]:
+        """Fetch-and-account every deferred ingest still in flight; returns
+        their aggregate (None when nothing was pending) so the caller can
+        report the tail's episode counts."""
+        agg: Optional[Dict[str, float]] = None
+        while self._stats_fifo:
+            stats = self._account(self._stats_fifo.popleft())
+            if agg is None:
+                agg = {
+                    "episodes": 0, "game_steps": 0, "player_steps": 0,
+                    "outcome_sum": 0.0, "outcome_sq_sum": 0.0,
+                }
+            agg["episodes"] += int(stats["episodes"])
+            agg["game_steps"] += int(stats["game_steps"])
+            agg["player_steps"] += int(stats["player_steps"])
+            agg["outcome_sum"] += float(stats["outcome_sum"].sum())
+            agg["outcome_sq_sum"] += float(stats["outcome_sq_sum"])
+        return agg
 
     def drain(self) -> None:
         """Block on the last in-flight ingest (see StreamingDeviceRollout
@@ -334,13 +378,21 @@ class DeviceReplay:
 
     def eligible_count(self) -> int:
         """Number of sampleable window starts (host sync — call before the
-        first train step, not per step)."""
+        first train step, or sparingly from a consumer waiting on warmup,
+        not per step).  Reads the rings under this mesh's dispatch locks:
+        a concurrent ingest donates the old ring buffers, and an eager
+        read racing that swap would touch deleted arrays."""
         if self.rings is None:
             return 0
-        return int(jax.device_get(_eligibility(
-            self.rings, self.args["forward_steps"],
-            self.args.get("burn_in_steps", 0),
-        ).sum()))
+        from ..parallel.mesh import dispatch_serialized
+
+        def _count():
+            return _eligibility(
+                self.rings, self.args["forward_steps"],
+                self.args.get("burn_in_steps", 0),
+            ).sum()
+
+        return int(jax.device_get(dispatch_serialized(_count, self.mesh)))
 
     # -- sample + train -----------------------------------------------------
 
@@ -460,9 +512,13 @@ def _eligibility(rings, forward_steps: int, burn_in_steps: int = 0):
 
 
 # per-step arrays the samplers consume positionally; everything else in the
-# record is an env compact-obs field handed to the obs reconstruction hook
+# record is an env compact-obs field handed to the obs reconstruction hook.
+# "reward"/"ret" are OPTIONAL: streaming rollouts derive a constant
+# step_reward in closed form (_step_returns) and never record them, while
+# host-born episodes (DeviceEpisodeStage) carry the generator's explicit
+# per-step columns in the ring
 _RECORD_FIELDS = ("active", "observing", "legal", "action", "prob", "value",
-                  "outcome")
+                  "outcome", "reward", "ret")
 
 
 def _draw_windows(rings, key, batch_size: int, forward_steps: int,
@@ -499,7 +555,7 @@ def _draw_windows(rings, key, batch_size: int, forward_steps: int,
     # final outcome lives in the episode's END slot record (younger than
     # train_start, so resident whenever train_start's valid flag survives)
     end_slot = (slot + (ep_end - gs0)) % S
-    return {
+    out = {
         "lane": lane, "slot": slot, "i_t": i_t, "gstep": gstep,
         "ep_end": ep_end,
         "ep_len": (ep_end - ep_start + 1).astype(jnp.float32),
@@ -516,6 +572,12 @@ def _draw_windows(rings, key, batch_size: int, forward_steps: int,
             k: gather(v) for k, v in rec.items() if k not in _RECORD_FIELDS
         },
     }
+    # explicit per-step reward/return columns (host-born episodes); the
+    # streaming path derives them in closed form instead (_step_returns)
+    for k in ("reward", "ret"):
+        if k in rec:
+            out[k] = gather(rec[k])
+    return out
 
 
 def _step_returns(venv, gamma: float, w: Dict[str, Any]):
@@ -563,15 +625,25 @@ def _sample_batch(rings, key, batch_size: int, venv, args: Dict[str, Any],
     tmask = live * act_p                                   # (N, T)
     omask = live * obs_p
 
-    planes = venv.view_obs(w["compact"], player)           # (N, T, planes, R, C)
-    obs = planes * omask[:, :, None, None, None]
-    obs = obs[:, :, None]                                  # (N, T, 1, planes, R, C)
+    # leaves (N, T, ...): single array for the vector envs, a pytree for
+    # host-born episodes whose obs is structured (DeviceEpisodeStage)
+    planes = venv.view_obs(w["compact"], player)
+    obs = tree_map(
+        lambda x: (
+            x * omask.reshape(omask.shape + (1,) * (x.ndim - 2))
+        )[:, :, None],                                     # (N, T, 1, ...)
+        planes,
+    )
 
     amask = jnp.where(
         legal_p & (tmask[..., None] > 0), 0.0, ILLEGAL
     ).astype(jnp.float32)[:, :, None]                      # (N, T, 1, A)
 
-    reward, ret = _step_returns(venv, args["gamma"], w)
+    if "reward" in w:   # explicit per-step columns (host-born episodes)
+        reward = pick_player(w["reward"]) * live
+        ret = pick_player(w["ret"]) * live
+    else:
+        reward, ret = _step_returns(venv, args["gamma"], w)
 
     progress = jnp.where(
         live_b, w["i_t"].astype(jnp.float32) / w["ep_len"][:, None], 1.0
@@ -628,8 +700,13 @@ def _sample_batch_turn(rings, key, batch_size: int, venv, args: Dict[str, Any],
         w["legal"] & (act[..., None] > 0), 0.0, ILLEGAL
     ).astype(jnp.float32)                                  # (N, T, P, A)
 
-    reward, ret = _step_returns(venv, args["gamma"], w)
     per_p = lambda x: jnp.broadcast_to(x[:, :, None, None], (batch_size, T, P, 1))
+    if "reward" in w:   # explicit per-step columns (host-born episodes)
+        reward_col = (w["reward"] * live[..., None])[..., None]  # (N, T, P, 1)
+        ret_col = (w["ret"] * live[..., None])[..., None]
+    else:
+        reward, ret = _step_returns(venv, args["gamma"], w)
+        reward_col, ret_col = per_p(reward), per_p(ret)
 
     # value: live rows carry the recorded estimate (x observing), rows past
     # the end freeze at the outcome, burn-in underflow rows are 0
@@ -648,11 +725,264 @@ def _sample_batch_turn(rings, key, batch_size: int, venv, args: Dict[str, Any],
         "value": value_b[..., None],
         "action": jnp.where(act > 0, w["action"], 0).astype(jnp.int32)[..., None],
         "outcome": outcome[:, None, :, None],
-        "reward": per_p(reward),
-        "return": per_p(ret),
+        "reward": reward_col,
+        "return": ret_col,
         "episode_mask": live[:, :, None, None],
         "turn_mask": act[..., None],
         "observation_mask": obsv[..., None],
         "action_mask": amask,
         "progress": progress[:, :, None],
     }
+
+
+# -- host-born episodes: wire blobs -> device rings ---------------------------
+
+
+class EpisodeObsView:
+    """venv-like shim for host-born episodes staged into device rings.
+
+    The streaming path reconstructs observations on device from an env's
+    COMPACT record fields (``venv.view_obs``); host-born episodes already
+    carry their full observation planes, so those live in the ring
+    verbatim (pytree leaves flattened under ``obs<i>`` keys) and
+    "reconstruction" is a per-player gather.  ``simultaneous``/ff mode
+    here means make_batch's non-turn-based layout — one uniform target
+    player per window — which is defined for ANY env's episodes, so the
+    flag is unconditionally true.  ``step_reward`` is unused: the ring
+    carries the generator's explicit per-step reward/return columns.
+    """
+
+    simultaneous = True
+    step_reward = 0.0
+
+    # DeviceReplay's constructor only probes for the streaming-hook's
+    # presence; the stage drives ingest with pre-built record chunks
+    record = None
+
+    def __init__(self, num_players: int, obs_treedef, n_obs_leaves: int):
+        self.num_players = num_players
+        self._treedef = obs_treedef
+        self._n = n_obs_leaves
+
+    def _tree(self, compact: Dict[str, Any]):
+        return jax.tree.unflatten(
+            self._treedef, [compact[f"obs{i}"] for i in range(self._n)]
+        )
+
+    def view_obs(self, compact: Dict[str, Any], player):
+        def pick(x):                         # (N, T, P, ...) -> (N, T, ...)
+            idx = player.reshape((-1, 1, 1) + (1,) * (x.ndim - 3))
+            idx = jnp.broadcast_to(idx, x.shape[:2] + (1,) + x.shape[3:])
+            return jnp.take_along_axis(x, idx, axis=2)[:, :, 0]
+
+        return tree_map(pick, self._tree(compact))
+
+    def view_obs_all(self, compact: Dict[str, Any]):
+        return self._tree(compact)           # leaves (N, T, P, ...)
+
+
+class DeviceEpisodeStage:
+    """Host-born episodes uploaded ONCE into DeviceReplay ring buffers.
+
+    The host-fed pipeline re-uploads every sampled observation window per
+    update (~43 MB/update on HungryGeese — BENCH_r05's 3 vs 376 updates/s
+    gap); this stage removes the host from the per-update path for
+    episodes that are BORN on the host (worker actors, remote workers):
+
+        episode (decoded dict, or the wire-codec bytes EpisodeStore
+        mirrors to batcher children)
+          -> per-step record columns, queued per lane  [host, once]
+          -> fixed-size (chunk, lanes) ingest calls    [one H2D per chunk]
+          -> DeviceReplay rings: windows sampled + assembled ON DEVICE by
+             the same programs the streaming path uses (parity pinned
+             key-by-key against make_batch by tests/test_device_stage.py)
+
+    Lane discipline: the ring invariant is that every lane advances one
+    slot per global step, so episodes queue per lane (shortest queue
+    first — greedy balancing) and a chunk flushes only when EVERY lane
+    has ``chunk_steps`` queued.  An episode's steps therefore occupy a
+    contiguous lane-local span whose indices EQUAL the ring's global
+    steps, which is what makes window bookkeeping exact.  Keep
+    ``n_lanes * chunk_steps`` well below ``minimum_episodes`` x the
+    typical episode length, or the first flush (and the trainer's first
+    batch) waits on generation.
+    """
+
+    def __init__(self, module, args: Dict[str, Any], mesh, n_lanes: int = 8,
+                 slots: int = 1024, chunk_steps: int = 64,
+                 track_episodes: bool = False):
+        # mirror DeviceReplay's ARG-side mode checks here, eagerly: the
+        # replay itself is built lazily from the first episode (it needs
+        # the player count and obs structure), which happens on a feeder
+        # thread — too late for make_pipeline's loud fallback
+        if args.get("turn_based_training", True):
+            if not args.get("observation", False):
+                raise ValueError(
+                    "batch_pipeline: device with turn_based_training: true "
+                    "requires observation: true (all-player windows; the "
+                    "turn-player-gather batch layout keeps the host path)"
+                )
+            min_slots = args.get("burn_in_steps", 0) + args["forward_steps"]
+            if slots <= min_slots:
+                raise ValueError(
+                    f"device_stage_slots must exceed burn_in_steps + "
+                    f"forward_steps = {min_slots}"
+                )
+        else:
+            if module.initial_state((1, 1)) is not None:
+                raise ValueError(
+                    "batch_pipeline: device with a recurrent net needs "
+                    "turn_based_training: true (whole-window hidden warmup)"
+                )
+            if args.get("burn_in_steps", 0) != 0:
+                raise ValueError(
+                    "batch_pipeline: device with turn_based_training: false "
+                    "requires burn_in_steps: 0"
+                )
+        dp = mesh.shape.get("dp", 1)
+        if n_lanes % dp:
+            rounded = max(dp, (n_lanes + dp - 1) // dp * dp)
+            import sys
+
+            print(
+                f"[handyrl_tpu] device_stage_lanes {n_lanes} rounded to "
+                f"{rounded} (lanes shard over the mesh's dp axis of {dp})",
+                file=sys.stderr,
+            )
+            n_lanes = rounded
+        self.module = module
+        self.args = args
+        self.mesh = mesh
+        self.n_lanes = n_lanes
+        self.slots = slots
+        self.chunk_steps = int(chunk_steps)
+        self.replay: Optional[DeviceReplay] = None
+        self._view: Optional[EpisodeObsView] = None
+        # per-lane FIFO of [rec_dict, offset] with (T, ...) numpy leaves
+        self._queues: List[List[list]] = [[] for _ in range(n_lanes)]
+        self._qlen = [0] * n_lanes     # pending (unflushed) steps
+        self._qtotal = [0] * n_lanes   # steps EVER enqueued = ring g of the
+        #                                lane's next step once flushed
+        self.episodes_staged = 0
+        self.steps_staged = 0
+        self.chunks_flushed = 0
+        # (g0, g1, episode) spans per lane — test/debug bookkeeping only
+        # (unbounded over a long run), enabled by track_episodes
+        self.spans: Optional[List[list]] = (
+            [[] for _ in range(n_lanes)] if track_episodes else None
+        )
+
+    # -- episode intake ------------------------------------------------------
+
+    def add_blob(self, blob: bytes) -> None:
+        """Stage one episode from its wire-codec bytes — the exact frames
+        ``EpisodeStore`` mirrors to shm batcher children."""
+        from . import codec
+
+        self.add_episode(codec.loads(blob))
+
+    def add_episode(self, episode: Dict[str, Any]) -> None:
+        """Decode one columnar episode into per-step record arrays and
+        queue it on the shortest lane."""
+        from .batch import _concat_columns
+        from .replay import decompress_block
+
+        cols = _concat_columns(
+            [decompress_block(b) for b in episode["blocks"]]
+        )
+        T = int(episode["steps"])
+        P = cols["prob"].shape[1]
+        outcome = np.asarray(
+            [episode["outcome"][p] for p in episode["players"]], np.float32
+        )
+        done = np.zeros((T,), bool)
+        done[-1] = True
+        rec = {
+            "active": cols["tmask"].astype(np.float32),
+            "observing": cols["omask"].astype(np.float32),
+            "legal": cols["amask"] == 0.0,
+            "action": cols["action"].astype(np.int32),
+            "prob": cols["prob"].astype(np.float32),
+            "value": cols["value"].astype(np.float32),
+            "reward": cols["reward"].astype(np.float32),
+            "ret": cols["ret"].astype(np.float32),
+            "outcome": np.broadcast_to(outcome, (T, P)).copy(),
+            "done": done,
+        }
+        obs_leaves, treedef = jax.tree.flatten(cols["obs"])
+        for i, leaf in enumerate(obs_leaves):
+            rec[f"obs{i}"] = np.asarray(leaf)
+        if self.replay is None:
+            self._view = EpisodeObsView(P, treedef, len(obs_leaves))
+            self.replay = DeviceReplay(
+                self._view, self.module, self.args, self.mesh,
+                self.n_lanes, slots=self.slots,
+            )
+        lane = min(range(self.n_lanes), key=lambda i: self._qlen[i])
+        if self.spans is not None:
+            self.spans[lane].append(
+                (self._qtotal[lane], self._qtotal[lane] + T - 1, episode)
+            )
+        self._queues[lane].append([rec, 0])
+        self._qlen[lane] += T
+        self._qtotal[lane] += T
+        self.episodes_staged += 1
+        self.steps_staged += T
+
+    # -- chunk assembly + flush ----------------------------------------------
+
+    def _take(self, lane: int, k: int) -> Dict[str, np.ndarray]:
+        """Pop ``k`` steps off a lane's queue (possibly spanning episode
+        boundaries) as one concatenated record dict with (k, ...) leaves."""
+        q = self._queues[lane]
+        parts: List[Dict[str, np.ndarray]] = []
+        left = k
+        while left > 0:
+            rec, off = q[0]
+            T = rec["done"].shape[0]
+            take = min(left, T - off)
+            parts.append({key: val[off:off + take] for key, val in rec.items()})
+            if off + take == T:
+                q.pop(0)
+            else:
+                q[0][1] = off + take
+            left -= take
+        self._qlen[lane] -= k
+        if len(parts) == 1:
+            return parts[0]
+        return {
+            key: np.concatenate([p[key] for p in parts]) for key in parts[0]
+        }
+
+    def ready(self) -> bool:
+        """True when every lane has a full chunk queued."""
+        return self.replay is not None and min(self._qlen) >= self.chunk_steps
+
+    def flush(self) -> int:
+        """Fold every complete (chunk, lanes) block into the rings; returns
+        the number of chunks ingested.  Stats fetches are deferred
+        (ingest_counted defer=True) so consecutive chunks overlap."""
+        n = 0
+        K = self.chunk_steps
+        while self.ready():
+            chunks = [self._take(lane, K) for lane in range(self.n_lanes)]
+            records = {
+                key: np.stack([c[key] for c in chunks], axis=1)  # (K, B, ...)
+                for key in chunks[0]
+            }
+            self.replay.ingest_counted(records, defer=True)
+            self.chunks_flushed += 1
+            n += 1
+        return n
+
+    def eligible(self) -> int:
+        """Sampleable window starts currently resident (host sync)."""
+        if self.replay is None:
+            return 0
+        return self.replay.eligible_count()
+
+    def drain(self) -> None:
+        """Settle deferred stats and block on the last in-flight ingest."""
+        if self.replay is not None:
+            self.replay.flush_counted()
+            self.replay.drain()

@@ -1068,63 +1068,121 @@ class Learner:
         hidden = self.module.initial_state(
             (self._device_games, self._venv.num_players)
         )
+        from collections import deque
+
         pending_steps = 0   # game steps from batches that finished 0 episodes
         dispatches = 0
-        while self._rollout_live(gen):
-            if self.num_returned_episodes >= self._next_update_episodes:
-                time.sleep(0.02)   # epoch episode budget met: yield the chip
-                self._rollout_beat()  # backpressure idle is healthy
-                if split:
-                    plane_stats.bump(actor_idle_s=0.02)
-                continue
-            if self._maybe_wedge(gen, dispatches):
-                return
-            epoch, params = self._actor_params()
-            t_busy = time.perf_counter()
-            key, sub = jax.random.split(key)
-            vstate, hidden, records = dispatch_serialized(
-                lambda: self._stream_fn(params, vstate, hidden, sub),
-                roll_mesh,
-            )
-            if split:
-                records = record_xfer(records)
-            stats = self._replay.ingest_counted(records)
-            dispatches += 1
-            self._rollout_dispatched = True  # arms stall detection
-            self._rollout_beat()
-            if split:
-                plane_stats.bump(
-                    actor_busy_s=time.perf_counter() - t_busy
-                )
-            n = int(stats["episodes"])
-            if not self._rollout_live(gen):
-                return
-            pending_steps += int(stats["game_steps"])
-            if n == 0:
-                continue   # steps stay in pending_steps for the next report
-            counts = {
-                "episodes": n,
-                "players": self._venv.num_players,
-                "model_id": epoch,
-                "game_steps": pending_steps,
-                "outcome_sum": float(stats["outcome_sum"].sum()),
-                "outcome_sq_sum": float(stats["outcome_sq_sum"]),
-            }
-            pending_steps = 0
-            # same patience loop as _device_rollout_inner: the server can
-            # be busy for minutes at an epoch boundary
-            fut: Future = Future()
-            self._requests.put(("device_counts", counts, fut))
-            while not fut.done():
-                try:
-                    fut.result(timeout=5.0)
-                    self._rollout_beat()  # served: the wait was the server's
-                except (TimeoutError, FutureTimeoutError):
-                    self._rollout_beat()  # waiting on a busy server ≠ a stall
-                    if not self._rollout_live(gen):
-                        return
-                except Exception:
+        # model epoch per in-flight deferred ingest, aligned with
+        # DeviceReplay's stats FIFO: the stats that come back are one
+        # dispatch old, and booking them under the CURRENT epoch would
+        # misattribute one k_steps block's generation stats at every
+        # model publish
+        epoch_fifo: deque = deque()
+        try:
+            while self._rollout_live(gen):
+                if self.num_returned_episodes >= self._next_update_episodes:
+                    time.sleep(0.02)   # epoch episode budget met: yield the chip
+                    self._rollout_beat()  # backpressure idle is healthy
+                    if split:
+                        plane_stats.bump(actor_idle_s=0.02)
+                    continue
+                if self._maybe_wedge(gen, dispatches):
                     return
+                epoch, params = self._actor_params()
+                t_busy = time.perf_counter()
+                key, sub = jax.random.split(key)
+                vstate, hidden, records = dispatch_serialized(
+                    lambda: self._stream_fn(params, vstate, hidden, sub),
+                    roll_mesh,
+                )
+                if split:
+                    records = record_xfer(records)
+                # deferred stats (the direct-ingest hot path): the records
+                # go straight into the learner-mesh rings and the scalar
+                # fetch for dispatch N happens only after N+1 is enqueued —
+                # the rollout thread never synchronizes on an ingest.  The
+                # returned stats are therefore ONE DISPATCH OLD (None on
+                # the first), which only lags the books by one k_steps
+                # block — their model epoch rides epoch_fifo so the
+                # generation-stats attribution stays exact; the tail is
+                # flushed in the finally below.
+                epoch_fifo.append(epoch)
+                stats = self._replay.ingest_counted(records, defer=True)
+                dispatches += 1
+                self._rollout_dispatched = True  # arms stall detection
+                self._rollout_beat()
+                if split:
+                    plane_stats.bump(
+                        actor_busy_s=time.perf_counter() - t_busy
+                    )
+                if not self._rollout_live(gen):
+                    return
+                if stats is None:
+                    continue
+                stats_epoch = epoch_fifo.popleft()  # the dispatch they're from
+                n = int(stats["episodes"])
+                pending_steps += int(stats["game_steps"])
+                if n == 0:
+                    continue   # steps stay in pending_steps for the next report
+                counts = {
+                    "episodes": n,
+                    "players": self._venv.num_players,
+                    "model_id": stats_epoch,
+                    "game_steps": pending_steps,
+                    "outcome_sum": float(stats["outcome_sum"].sum()),
+                    "outcome_sq_sum": float(stats["outcome_sq_sum"]),
+                }
+                pending_steps = 0
+                if not self._submit_counts(counts, gen):
+                    return
+        finally:
+            # settle the deferred tail so its episodes still reach the
+            # books — but only while the run is live (a watchdog restart):
+            # a shutdown-time submission could push num_returned_episodes
+            # over the next boundary and conjure a spurious extra epoch
+            # out of the drain (pre-deferral behavior dropped the tail)
+            try:
+                left = self._replay.flush_counted()
+            except Exception:
+                left = None
+            if self.shutdown_flag:
+                left = None
+            if left and (int(left["episodes"]) > 0 or pending_steps):
+                counts = {
+                    "episodes": int(left["episodes"]),
+                    "players": self._venv.num_players,
+                    # oldest in-flight dispatch's epoch, not the current
+                    # model_epoch: a restart racing a model publish would
+                    # otherwise book the tail under a model that never
+                    # generated it (the tail can span several epochs; the
+                    # oldest is the closest single attribution)
+                    "model_id": int(epoch_fifo[0]) if epoch_fifo else self.model_epoch,
+                    "game_steps": pending_steps + int(left["game_steps"]),
+                    "outcome_sum": float(left["outcome_sum"]),
+                    "outcome_sq_sum": float(left["outcome_sq_sum"]),
+                }
+                # same submission protocol as the loop body (patience while
+                # this generation is live; a superseded/stopping thread
+                # gives up instead of blocking teardown)
+                self._submit_counts(counts, gen)
+
+    def _submit_counts(self, counts: Dict[str, Any], gen: int) -> bool:
+        """Report ingest counters to the server loop with the same patience
+        loop as _device_rollout_inner (the server can be busy for minutes
+        at an epoch boundary).  False = stop the rollout loop."""
+        fut: Future = Future()
+        self._requests.put(("device_counts", counts, fut))
+        while not fut.done():
+            try:
+                fut.result(timeout=5.0)
+                self._rollout_beat()  # served: the wait was the server's
+            except (TimeoutError, FutureTimeoutError):
+                self._rollout_beat()  # waiting on a busy server ≠ a stall
+                if not self._rollout_live(gen):
+                    return False
+            except Exception:
+                return False
+        return True
 
     def _device_rollout_inner(self, roll, key, gen: int) -> None:
         import jax

@@ -258,9 +258,16 @@ class ShmBatchPipeline:
 
         self._local_batch = local_batch_size(args["batch_size"])
         self._fused = max(1, args.get("fused_steps", 1))
-        # the fused device-put drains `fused` ready slots before freeing
-        # any; fewer than fused+1 slots would deadlock the ring
-        self._n_slots = max(int(args.get("shm_slots", 6)), self._fused + 2, 2)
+        # the consumer double-buffers H2D transfers (one group transferring
+        # while the next is drained from the ring), so up to TWO fused
+        # groups' slots can be pinned in flight at once; fewer than
+        # 2*fused + 1 free-able slots would stall the children exactly when
+        # the overlap is supposed to keep them filling.  The clamp lives in
+        # config.effective_shm_slots — validate_args checks num_batchers
+        # against the same number
+        from ..config import effective_shm_slots
+
+        self._n_slots = effective_shm_slots(dict(args, fused_steps=self._fused))
         self._device_queue: thqueue.Queue = thqueue.Queue(
             maxsize=args.get("prefetch_batches", 2)
         )
@@ -655,6 +662,29 @@ class ShmBatchPipeline:
     def _device_put_loop(self) -> None:
         import jax
 
+        # Transfers IN FLIGHT: a group's slots recycle only after ITS
+        # transfer completes (an in-flight DMA must never see a
+        # half-overwritten slot), but the consumer no longer parks the
+        # whole ring on that completion.  The old synchronous
+        # block_until_ready here was what serialized the multi-batcher
+        # plane: every child funnelled through one consumer that spent the
+        # H2D time neither draining ready records nor recycling slots, so
+        # past one child the extra fills just queued behind it.  Depth 2
+        # (one group transferring while the next is drained + dispatched)
+        # is the classic double buffer; _n_slots is clamped to 2*fused + 2
+        # so the ring always has a dealable slot with two groups pinned.
+        inflight: deque = deque()
+
+        def retire_oldest() -> None:
+            device_batch, done_slots = inflight.popleft()
+            t0 = time.perf_counter()
+            jax.block_until_ready(device_batch)
+            with self._lock:
+                self._stats["put_s"] += time.perf_counter() - t0
+            for slot in done_slots:
+                self._slot_gen[slot] += 1
+                self._deal_slot(slot)
+
         try:
             while not self.stop_event.is_set():
                 group, slots = [], []
@@ -684,24 +714,25 @@ class ShmBatchPipeline:
                     self._stats["batches"] += len(group)
                 # hand the (possibly still-transferring) batch to the
                 # trainer FIRST — its async train-step dispatch overlaps
-                # the rest of the H2D copy...
+                # the rest of the H2D copy
                 queued = self._put_device(device_batch)
-                # ...but the slots recycle only after the transfer has
-                # finished reading them: an in-flight DMA must never see a
-                # half-overwritten slot
-                t0 = time.perf_counter()
-                jax.block_until_ready(device_batch)
-                with self._lock:
-                    self._stats["put_s"] += time.perf_counter() - t0
-                for slot in slots:
-                    self._slot_gen[slot] += 1
-                    self._deal_slot(slot)
+                inflight.append((device_batch, slots))
+                while len(inflight) > 1:
+                    retire_oldest()
                 if not queued:
                     return
         except Exception:
             traceback.print_exc()
             self.stop_event.set()
         finally:
+            # settle every outstanding transfer (recycling its slots) so
+            # close() — and a degradation's thread fallback — find a
+            # consistent ring
+            try:
+                while inflight:
+                    retire_oldest()
+            except Exception:
+                pass
             # degradation keeps the learner alive on the thread pipeline;
             # the shm plane itself still tears down completely
             self.close()

@@ -74,18 +74,33 @@ def make_pipeline(args: Dict[str, Any], store: EpisodeStore, ctx: TrainContext,
 
     ``batch_pipeline: shm`` (the default) with ``num_batchers > 0`` forks
     GIL-free batcher processes writing into shared memory
-    (runtime/shm_batch.py); ``thread`` — or num_batchers 0, or any
-    platform where the shm plane cannot come up — uses the in-process
-    threaded pipeline.  Both expose start()/batch()/stop()/stats()."""
+    (runtime/shm_batch.py); ``device`` uploads host-born episodes ONCE
+    into device ring buffers and samples/assembles training windows on
+    device (runtime/device_batch.py — make_batch and the per-update
+    observation H2D re-upload leave the hot loop); ``thread`` — or
+    num_batchers 0, or any platform where the shm plane cannot come up —
+    uses the in-process threaded pipeline.  All three expose
+    start()/batch()/stop()/stats()."""
     mode = args.get("batch_pipeline", "shm")
+    if mode == "device":
+        try:
+            from .device_batch import DeviceBatchPipeline
+
+            return DeviceBatchPipeline(args, store, ctx, stop_event)
+        except Exception:
+            traceback.print_exc()
+            print(
+                "[handyrl_tpu] device batch pipeline unavailable (above); "
+                "falling back to the shm assembly plane",
+                file=sys.stderr,
+            )
+            mode = "shm"
     if mode == "shm" and int(args.get("num_batchers", 0)) > 0:
         try:
             from .shm_batch import ShmBatchPipeline
 
             return ShmBatchPipeline(args, store, ctx, stop_event)
         except Exception:
-            import sys
-
             traceback.print_exc()
             print(
                 "[handyrl_tpu] shared-memory batch pipeline unavailable "
@@ -273,6 +288,12 @@ class Trainer:
             dict(args, fused_steps=self.fused), self.store, self.ctx, self.stop_event
         )
         self._pipe_stats0: Dict[str, float] = {}
+        # the run's FIRST batch wait is pipeline warm-up (template
+        # assembly, child spawn + replica seeding, ring prefill), not
+        # steady-state starvation — reported separately so the north-star
+        # input_wait_frac stays honest (mirrors the plane watchdog's
+        # compile-grace: warm-up must not read as a fault)
+        self._warmup_wait_pending = True
 
         # device-resident replay (runtime/device_replay.py): set by the
         # Learner before run() when train_args.device_replay is true; the
@@ -552,6 +573,7 @@ class Trainer:
         metric_accum = []
         lr = self.lr
         wait_s = 0.0
+        warmup_wait_s = 0.0
         t_epoch = time.perf_counter()
         fused = self.fused
         if self.device_replay is not None:
@@ -588,7 +610,18 @@ class Trainer:
             while data_cnt == 0 or not self.update_flag:
                 t0 = time.perf_counter()
                 batch = self.batcher.batch()
-                wait_s += time.perf_counter() - t0  # input starvation (north-star)
+                batch_wait = time.perf_counter() - t0
+                if self._warmup_wait_pending:
+                    # first batch of the RUN: the wait covers the assembly
+                    # plane's one-off warm-up, and the first train dispatch
+                    # right after it pays the jit compile — neither is
+                    # steady-state input starvation, so it must not sit in
+                    # the north-star input_wait_frac (it lands in its own
+                    # input_wait_warmup_s stat instead)
+                    self._warmup_wait_pending = False
+                    warmup_wait_s = batch_wait
+                else:
+                    wait_s += batch_wait  # input starvation (north-star)
                 if batch is None:  # shutting down
                     break
                 last_batch = batch  # batches aren't donated; safe to re-lower
@@ -625,6 +658,10 @@ class Trainer:
             "train_steps_per_sec": batch_cnt / elapsed,
             "input_wait_frac": wait_s / elapsed,
         }
+        if warmup_wait_s:
+            # one-off, first trained epoch only: the pipeline warm-up wait
+            # excluded from input_wait_frac above
+            self.stats["input_wait_warmup_s"] = round(warmup_wait_s, 4)
         if self.sentinel:
             # cumulative, like pipe_batcher_*: a nonzero value anywhere in
             # the run means the sentinel fired at some point

@@ -411,6 +411,44 @@ def test_learner_geister_device_replay_end_to_end(tmp_path, monkeypatch):
     )
 
 
+def test_ingest_counted_deferred_matches_sync(rollout_data):
+    """The direct-ingest hot path (learner rollout thread): deferred stats
+    fetching (ingest_counted defer=True + flush_counted) must land the
+    same cumulative counters as the synchronous per-dispatch fetch — the
+    deferral only moves WHEN the scalar fetch happens, never what it
+    counts."""
+    env = make_env({"env": "HungryGeese"})
+    module = env.net()
+    params = init_variables(module, env)["params"]
+    args = rollout_data["args"]
+    mesh = rollout_data["mesh"]
+    fn = build_streaming_fn(VectorHungryGeese, module, 4, 16, mesh=None,
+                            use_observe_mask=False)
+    sync = DeviceReplay(VectorHungryGeese, module, args, mesh, 4, slots=64)
+    deferred = DeviceReplay(VectorHungryGeese, module, args, mesh, 4, slots=64)
+    state = VectorHungryGeese.init(4, jax.random.PRNGKey(21))
+    key = jax.random.PRNGKey(22)
+    chunks = []
+    for _ in range(5):
+        key, sub = jax.random.split(key)
+        state, _, records = fn(params, state, None, sub)
+        chunks.append(tree_map(np.asarray, jax.device_get(records)))
+    returned_eps = 0
+    for rec in chunks:
+        sync.ingest_counted(rec)
+        out = deferred.ingest_counted(rec, defer=True)
+        if out is not None:
+            returned_eps += int(out["episodes"])
+    # mid-stream the deferred side lags exactly one dispatch
+    assert deferred.counters["episodes"] <= sync.counters["episodes"]
+    tail = deferred.flush_counted()
+    assert tail is not None
+    returned_eps += int(tail["episodes"])
+    assert deferred.counters == sync.counters
+    # every episode was also RETURNED to the caller exactly once
+    assert returned_eps == sync.counters["episodes"]
+
+
 def test_ingest_stats_match_records(rollout_data):
     """Ingest counters must agree with host-side counting of the same
     records (episodes finished, game/player steps)."""

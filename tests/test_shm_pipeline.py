@@ -270,6 +270,77 @@ def test_fused_grouping_through_shm_pipeline():
         pipe.stop()
 
 
+# -- multi-batcher slot accounting (ISSUE 6) ---------------------------------
+
+
+@pytest.mark.parametrize("nb", [2, 4])
+def test_multi_batcher_slot_accounting(nb):
+    """The shm plane at 2 and 4 children: every ring slot is dealt AND
+    consumed, recycled through the generation counter, and no (slot,
+    generation) pair ever circulates twice — the invariant that makes a
+    reclaimed slot's stale ready message self-invalidating."""
+    targs = _targs(batch_size=4, forward_steps=8, num_batchers=nb,
+                   shm_slots=5)
+    store, _ = _gen_store("TicTacToe", 8, targs)
+    stop = threading.Event()
+    pipe = ShmBatchPipeline(targs, store, _HostCtx(), stop)
+    seen = []
+    orig = pipe._ready_get
+
+    def spy():
+        item = orig()
+        if item is not None:
+            # generation at consume time == generation stamped at fill
+            # time (the recycle bump happens strictly after this return)
+            seen.append((item[0], int(pipe._slot_gen[item[0]])))
+        return item
+
+    pipe._ready_get = spy
+    pipe.start()
+    try:
+        assert pipe._fallback is None, "shm plane fell back to threads"
+        n_slots = pipe._n_slots
+        for _ in range(3 * n_slots):
+            assert pipe.batch() is not None
+        assert len(seen) >= 3 * n_slots
+        # a (slot, generation) consumed twice = a slot circulating twice
+        assert len(set(seen)) == len(seen), "a slot generation was consumed twice"
+        # every slot of the ring was dealt to a child and flowed through
+        assert {s for s, _ in seen} == set(range(n_slots))
+        # every child held work (round-robin dealing reaches all children)
+        deaths = pipe.stats()["batcher_deaths"]
+        assert deaths == 0
+    finally:
+        stop.set()
+        pipe.stop()
+
+
+@pytest.mark.slow  # three pipeline spawns; the CI pipeline step still runs it
+def test_multi_batcher_parity_with_single_child():
+    """Deterministic single-short-episode setup (window sampling collapses
+    to train_start 0, whole episode): batches from 2- and 4-child rings
+    must be bit-identical to the 1-child configuration's, which is itself
+    pinned to make_batch."""
+    base = _targs(batch_size=2, forward_steps=16, num_batchers=1)
+    store, eps = _gen_store("TicTacToe", 1, base)
+    assert eps[0]["steps"] <= 16
+    windows = [store.sample_window(16, 0, 4) for _ in range(2)]
+    ref = make_batch(windows, base)
+    for nb in (1, 2, 4):
+        targs = dict(base, num_batchers=nb)
+        stop = threading.Event()
+        pipe = ShmBatchPipeline(targs, store, _HostCtx(), stop)
+        pipe.start()
+        try:
+            assert pipe._fallback is None
+            got = pipe.batch()
+            assert got is not None
+            _assert_batches_identical(ref, got)
+        finally:
+            stop.set()
+            pipe.stop()
+
+
 # -- factory + config wiring -------------------------------------------------
 
 
@@ -289,6 +360,14 @@ def test_config_validates_pipeline_knobs():
         _targs(batch_pipeline="fiber")
     with pytest.raises(ValueError):
         _targs(shm_slots=1)
+    # loud at startup, not deep in shm_batch setup (ISSUE 6 satellite):
+    # a negative batcher count, or more children than ring slots (a child
+    # beyond the ring depth would never hold a slot)
+    with pytest.raises(ValueError):
+        _targs(num_batchers=-1)
+    with pytest.raises(ValueError):
+        _targs(num_batchers=9, shm_slots=6)
+    assert _targs(num_batchers=0)["num_batchers"] == 0  # 0 = threaded
     assert _targs()["batch_pipeline"] == "shm"
 
 
