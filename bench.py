@@ -1368,6 +1368,199 @@ def _flash_attention_bench(duration: float = 3.0):
     }
 
 
+# ---------------------------------------------------------------------------
+# transformer_long: the long-context train step at production shapes
+# (ROADMAP item 5) — T x attention-mode sweep + an sp=2 ring leg
+# ---------------------------------------------------------------------------
+
+# module-level pins so CI can trace/exercise the exact sweep geometry
+# (same contract as TRANSFORMER_TPU_NET_ARGS below).  TPU: the d1536 knee
+# shape from the 2026-08-02 width sweep, batch shrinking with T so the
+# remat ladder (auto -> 'block' at T >= 512) is what fits T1024 in HBM,
+# not a vanishing batch.  CPU: tiny shapes through the IDENTICAL code
+# path — interpret-mode Pallas for the flash points, flash_min_t lowered
+# so the 'auto' points exercise both sides of the crossover.
+TRANSFORMER_LONG_TPU = {
+    "net_args": {"d_model": 1536, "n_heads": 16, "n_layers": 8,
+                 "memory_len": 32},
+    "sweep_t": (64, 512, 1024),
+    "batch_by_t": {64: 64, 512: 16, 1024: 8},
+    "flash_min_t": 128,
+    "compute_dtype": "bfloat16",
+    "sp_t": 512,
+    "sp_batch": 16,
+}
+TRANSFORMER_LONG_CPU = {
+    "net_args": {"d_model": 64, "n_heads": 2, "n_layers": 2,
+                 "memory_len": 16},
+    "sweep_t": (8, 16, 32),
+    "batch_by_t": {8: 8, 16: 8, 32: 8},
+    "flash_min_t": 16,
+    "compute_dtype": "float32",
+    "sp_t": 16,
+    "sp_batch": 8,
+}
+TRANSFORMER_LONG_MFU_TARGET = 0.40
+
+
+def _compiled_peak_bytes(ctx, state, batch):
+    """Peak on-device bytes of the bound train step, from XLA's compiled
+    memory analysis (temp + arguments + outputs).  AOT-compiles the same
+    program a second time, so callers only invoke it where that is cheap
+    (CPU) or worth a few minutes (the longest-T points of a real-TPU
+    capture, where the remat ladder's HBM story is the point)."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        lowered = ctx._bind(state).lower(
+            state, batch, jax.ShapeDtypeStruct((), jnp.float32)
+        )
+        ma = lowered.compile().memory_analysis()
+        if ma is None:
+            return None
+        total = 0
+        for attr in ("temp_size_in_bytes", "argument_size_in_bytes",
+                     "output_size_in_bytes"):
+            total += int(getattr(ma, attr, 0) or 0)
+        return total or None
+    except Exception:
+        return None
+
+
+def _transformer_long_bench(duration: float, n_dev: int, peak):
+    """One training semantics from T64 on one chip to T1024 across an sp
+    mesh: sweep T x seq_attention {einsum, flash, auto} through the SAME
+    TrainContext path as every other stage (real Geister windows, real
+    losses, Adam), plus a dp x sp ring-attention leg — each point
+    reporting updates/s, tokens/s, MFU and (where measured) peak device
+    bytes, judged against transformer_long_mfu >= 0.40.
+
+    The remat ladder rides along as 'auto' (resolve_seq_remat: 'block' at
+    T >= 512 on TPU), and the remat-none memory headroom at the longest T
+    is recorded from an AOT compile of the same program — the
+    OOM-by-construction comparison that motivated the ladder."""
+    import jax
+    import jax.numpy as jnp
+
+    from handyrl_tpu.parallel import resolve_seq_attention, resolve_seq_remat
+    from handyrl_tpu.parallel.train_step import TrainContext
+    from handyrl_tpu.parallel.mesh import make_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    pins = TRANSFORMER_LONG_TPU if on_tpu else TRANSFORMER_LONG_CPU
+    env_over = {"net": "transformer", "net_args": pins["net_args"]}
+    modes = ("einsum", "flash", "auto")
+    per_point = max(1.5, duration / (len(pins["sweep_t"]) * len(modes) + 1))
+
+    def overrides(T, mode, B):
+        return {
+            "batch_size": B, "burn_in_steps": 0, "forward_steps": T,
+            "observation": True, "seq_attention": mode,
+            "flash_min_t": pins["flash_min_t"],
+            "compute_dtype": pins["compute_dtype"], "remat": "auto",
+        }
+
+    points = {}
+    reuse = None
+    mem_tr = None  # the longest-T point, kept for the memory comparison
+    for T in pins["sweep_t"]:
+        for mode in modes:
+            B = pins["batch_by_t"][T]
+            _note(f"transformer_long: T{T} {mode} B{B}")
+            tr = _train_bench(
+                "Geister", overrides(T, mode, B), per_point, n_dev,
+                fill_episodes=8, reuse=reuse, env_overrides=env_over,
+            )
+            reuse = reuse or tr
+            args = tr["args"]
+            ups = tr["updates_per_sec"]
+            tokens = args["batch_size"] * 2 * T  # 2 players per window row
+            points[f"T{T}_{mode}"] = {
+                "updates_per_sec": ups,
+                "tokens_per_sec": ups * tokens,
+                "attn": resolve_seq_attention(args, T),
+                "remat": resolve_seq_remat(args, T),
+                "mfu": (tr["flops_per_step"] * ups / (peak * n_dev))
+                if tr["flops_per_step"] and peak else None,
+                "peak_bytes": None,
+            }
+            if T == pins["sweep_t"][-1] and mode == "auto":
+                mem_tr = tr
+    # peak-memory story at the longest T: the remat-'block' program vs a
+    # remat-'none' AOT compile of the SAME step (never executed — at
+    # production shapes remat: none is the configuration that OOMs, the
+    # d2048 width-sweep collapse)
+    remat_headroom = None
+    if mem_tr is not None:
+        T_max = pins["sweep_t"][-1]
+        args = mem_tr["args"]
+        try:
+            batch_host = _sample_batch(mem_tr["store"], args)
+            mems = {}
+            for rung in ("block", "none"):
+                ctx = TrainContext(
+                    mem_tr["module"], dict(args, remat=rung),
+                    make_mesh(args["mesh"]),
+                )
+                state = ctx.init_state(mem_tr["model"].variables["params"])
+                mems[rung] = _compiled_peak_bytes(
+                    ctx, state, ctx.put_batch(batch_host)
+                )
+            # the point's peak_bytes must describe the program it MEASURED
+            # (auto resolves 'none' on CPU, 'block' on TPU at long T); the
+            # block-vs-none pair rides separately as remat_headroom
+            measured_rung = points[f"T{T_max}_auto"]["remat"]
+            points[f"T{T_max}_auto"]["peak_bytes"] = mems.get(measured_rung)
+            if mems["block"] and mems["none"]:
+                remat_headroom = {
+                    "block": mems["block"], "none": mems["none"],
+                    "ratio": round(mems["none"] / mems["block"], 3),
+                }
+        except Exception:
+            _note("transformer_long: peak-memory comparison unavailable "
+                  f"({traceback.format_exc(limit=1).splitlines()[-1]})")
+
+    # sp=2 ring leg: the same train step with T sharded over an sp mesh
+    sp_leg = None
+    sp_note = None
+    if n_dev >= 2:
+        dp = max(n_dev // 2, 1)
+        T, B = pins["sp_t"], pins["sp_batch"]
+        B = max(dp, B // dp * dp)
+        _note(f"transformer_long: sp=2 ring leg (dp{dp} x sp2, T{T} B{B})")
+        tr = _train_bench(
+            "Geister",
+            dict(overrides(T, "ring", B), mesh={"dp": dp, "sp": 2}),
+            per_point, dp, fill_episodes=8, reuse=reuse,
+            env_overrides=env_over,
+        )
+        ups = tr["updates_per_sec"]
+        sp_leg = {
+            "updates_per_sec": ups,
+            "tokens_per_sec": ups * tr["args"]["batch_size"] * 2 * T,
+            "attn": "ring",
+            "mfu": (tr["flops_per_step"] * ups / (peak * n_dev))
+            if tr["flops_per_step"] and peak else None,
+        }
+    else:
+        sp_note = "single device: no sp axis to shard over"
+
+    mfus = [p["mfu"] for p in points.values() if p.get("mfu")]
+    best = max(mfus) if mfus else None
+    return {
+        "points": points,
+        "sp2": sp_leg,
+        "sp2_note": sp_note,
+        "remat_headroom": remat_headroom,
+        "mfu": best,
+        # judged on real-TPU captures; None (not false) where MFU cannot
+        # be computed, so a CPU smoke never reads as a missed target
+        "target_met": (best >= TRANSFORMER_LONG_MFU_TARGET)
+        if best is not None and on_tpu else None,
+    }
+
+
 # the transformer stage's on-chip shape (module-level so CI can trace the
 # EXACT program the driver bench will compile on the TPU — the stage is
 # TPU-gated, so without that trace a shape bug would first surface
@@ -1381,16 +1574,20 @@ TRANSFORMER_TPU_NET_ARGS = {"d_model": 1536, "n_heads": 16, "n_layers": 8,
 TRANSFORMER_TPU_OVERRIDES = {"batch_size": 64, "burn_in_steps": 2,
                              "forward_steps": 62, "observation": True,
                              "compute_dtype": "bfloat16",
-                             # flash-vs-einsum was settled on-chip at the
-                             # d1024 pin (2026-08-02): einsum 18.6 updates/s
-                             # (MFU 0.48) vs flash 13.5 (0.347) — at T64 the
-                             # O(T^2) term is tiny and XLA-fusable while the
-                             # Pallas kernel pays fixed launch/block
-                             # overhead.  The d1536 re-pin has only run
-                             # through tools/tune_transformer.py (MFU 0.597,
-                             # einsum) — not yet full-suite-captured; the
-                             # next capture should confirm einsum still wins
-                             # at this width.  'auto' (flash_min_t 128)
+                             # einsum at T64: settled on-chip at d1024
+                             # (2026-08-02: einsum 18.6 updates/s / MFU
+                             # 0.48 vs flash 13.5 / 0.347 — the O(T^2)
+                             # term is tiny and XLA-fusable at T64 while
+                             # the kernel pays fixed launch overhead), and
+                             # the d1536 evidence so far agrees (einsum
+                             # MFU 0.597 via tools/tune_transformer.py).
+                             # The d1536 crossover now has a DEDICATED
+                             # measurement: the transformer_long stage
+                             # sweeps T {64, 512, 1024} x {einsum, flash,
+                             # auto} at exactly this width — run
+                             # BENCH_STAGES=transformer_long on the next
+                             # lease and re-pin from its T64 row if flash
+                             # ever wins there.  'auto' (flash_min_t 128)
                              # picks einsum at T64 regardless; pinned
                              # explicitly so the stage measures one known
                              # program
@@ -1400,7 +1597,7 @@ KNOWN_STAGES = (
     "tictactoe", "device-selfplay", "geese-device-selfplay", "geese-gen",
     "geese-train", "northstar", "northstar2", "northstar3", "northstar4",
     "geese-bf16", "geister", "geister-device-selfplay", "geister-devreplay",
-    "transformer", "flash",
+    "transformer", "transformer_long", "flash",
 )
 # stages that consume another stage's result (main() gates them on it)
 STAGE_DEPS = {
@@ -1967,6 +2164,47 @@ def main() -> None:
             result["extra"]["transformer_mfu"] = None
             result["extra"]["transformer_mfu_note"] = "no flops from any lowering"
     _run_stage(result, "transformer", stage_transformer)
+
+    # 4e. long-context transformer at production shapes (ROADMAP item 5):
+    # T {64, 512, 1024} x attention mode {einsum, flash, auto} + an sp=2
+    # ring leg, all through the one TrainContext training semantics — the
+    # stage that records the d1536 flash-vs-einsum crossover, the remat
+    # ladder's HBM headroom, and the transformer_long_mfu >= 0.40 verdict.
+    # Runs on every backend: the CPU leg (tiny pins, interpret-mode
+    # Pallas) is the CI smoke that keeps the sweep + auto-pick + ring
+    # composition from rotting unexercised between TPU captures.
+    def stage_transformer_long():
+        tl = _transformer_long_bench(T_TRAIN, n_dev, peak)
+        for name, p in tl["points"].items():
+            key = f"transformer_long_{name}"
+            result["extra"][f"{key}_updates_per_sec"] = _sig(p["updates_per_sec"])
+            result["extra"][f"{key}_tokens_per_sec"] = _sig(p["tokens_per_sec"], 4)
+            result["extra"][f"{key}_attn"] = p["attn"]
+            result["extra"][f"{key}_remat"] = p["remat"]
+            if p["mfu"] is not None:
+                result["extra"][f"{key}_mfu"] = _sig(p["mfu"])
+            if p["peak_bytes"]:
+                result["extra"][f"{key}_peak_hbm_bytes"] = p["peak_bytes"]
+        if tl["sp2"]:
+            sp = tl["sp2"]
+            result["extra"]["transformer_long_sp2_updates_per_sec"] = _sig(
+                sp["updates_per_sec"]
+            )
+            result["extra"]["transformer_long_sp2_tokens_per_sec"] = _sig(
+                sp["tokens_per_sec"], 4
+            )
+            result["extra"]["transformer_long_sp2_attn"] = sp["attn"]
+            if sp["mfu"] is not None:
+                result["extra"]["transformer_long_sp2_mfu"] = _sig(sp["mfu"])
+        if tl["sp2_note"]:
+            result["extra"]["transformer_long_sp2_note"] = tl["sp2_note"]
+        if tl["remat_headroom"]:
+            result["extra"]["transformer_long_remat_headroom"] = tl["remat_headroom"]
+        if tl["mfu"] is not None:
+            result["extra"]["transformer_long_mfu"] = _sig(tl["mfu"])
+        result["extra"]["transformer_long_target_met"] = tl["target_met"]
+
+    _run_stage(result, "transformer_long", stage_transformer_long)
 
     # 5. seq-attention kernel crossover (einsum vs Pallas flash, fwd+bwd)
     def stage_flash():

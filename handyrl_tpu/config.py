@@ -182,15 +182,30 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     # whole-window attention training for transformer models (models that
     # set supports_seq); turn off to force the step-scan path
     "seq_forward": True,
-    # seq-mode attention implementation: 'auto' (Pallas masked flash
-    # attention on TPU when the window is >= flash_min_t, einsum
-    # elsewhere/shorter), 'flash', 'einsum', or 'ring' (sequence-parallel
-    # masked ring attention — needs an 'sp' mesh axis)
+    # seq-mode attention implementation ('attn_mode' is an accepted
+    # alias): 'auto' (Pallas masked flash attention when the window is
+    # >= flash_min_t, einsum shorter — on TPU compiled, on CPU via the
+    # exact Pallas interpreter; other backends fall back to einsum),
+    # 'flash', 'einsum', or 'ring' (sequence-parallel masked ring
+    # attention — needs an 'sp' mesh axis)
     "seq_attention": "auto",
     # auto-mode crossover: windows shorter than this use the exact einsum
-    # path even on TPU (the O(T^2) term is tiny and XLA-fusable at short
-    # T; the Pallas kernel pays fixed launch/block overhead)
+    # path (the O(T^2) term is tiny and XLA-fusable at short T; the
+    # Pallas kernel pays fixed launch/block overhead)
     "flash_min_t": 128,
+    # flash kernel tile sizes (query/key rows per VMEM block): power-of-two
+    # multiples of 8, clamped to the 128-lane tile inside the kernel.  128
+    # is the measured sweet spot; smaller tiles trade MXU utilization for
+    # less VMEM per program
+    "blk_q": 128,
+    "blk_k": 128,
+    # recompute ladder for the transformer seq path: 'none' (store every
+    # activation), 'attn' (recompute each attention sublayer in the
+    # backward), 'block' (recompute whole attention+FFN blocks — the lever
+    # that fits T1024 x d1536 in HBM), or 'auto' ('block' for T >= 512 on
+    # TPU, else 'none').  For RNN scan training the ladder collapses to
+    # on/off over the scan body (the historical remat: auto|true|false)
+    "remat": "auto",
     # 'bfloat16' runs the forward/backward compute in bf16 (MXU rate)
     # with fp32 master weights; 'float32' is exact
     "compute_dtype": "float32",
@@ -382,6 +397,59 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         )
     if int(train["flash_min_t"]) < 1:
         raise ValueError("train_args.flash_min_t must be >= 1")
+    for key in ("blk_q", "blk_k"):
+        b = int(train[key])
+        if b < 8 or (b & (b - 1)):
+            raise ValueError(
+                f"train_args.{key} must be a power of two >= 8 (8 sublanes x "
+                f"the 128-lane tile rule — pallas_guide 'Tiling Constraints'), "
+                f"got {train[key]}; the kernel clamps blocks above 128 down "
+                "to the lane tile"
+            )
+    rv = train["remat"]
+    # isinstance(bool) first: tuple membership would accept the ints 0/1
+    # via ==, which resolve_seq_remat (isinstance-based) would then read
+    # as 'auto' — one config value must not mean two things
+    if not (isinstance(rv, bool) or rv in ("auto", "none", "attn", "block")):
+        raise ValueError(
+            f"train_args.remat={rv!r} not one of "
+            "('auto', true, false, 'none', 'attn', 'block')"
+        )
+    mesh = train["mesh"]
+    if not isinstance(mesh, dict) or not mesh:
+        raise ValueError("train_args.mesh must be a non-empty axis->size dict")
+    for ax, size in mesh.items():
+        if not isinstance(size, int) or size == 0 or size < -1:
+            raise ValueError(
+                f"train_args.mesh[{ax!r}]={size!r}: axis sizes are positive "
+                "ints or -1 (fill remaining devices)"
+            )
+    if sum(1 for s in mesh.values() if s == -1) > 1:
+        raise ValueError(
+            "train_args.mesh: at most one axis may be -1 (fill) — "
+            f"got {mesh}"
+        )
+    if train["seq_attention"] == "ring" and train["remat"] in ("attn", "block", True):
+        raise ValueError(
+            "train_args.remat ladder is unsupported with seq_attention: "
+            "'ring' — the ring already partitions activation memory over "
+            "'sp' (each device holds one T/sp shard), and jax.checkpoint "
+            "around the shard_map ring loop fails its scan-carry "
+            "replication typing; use remat: none or auto"
+        )
+    if train["seq_attention"] == "ring":
+        sp = mesh.get("sp", 1)
+        if sp != -1 and sp < 2:
+            raise ValueError(
+                "train_args.seq_attention: 'ring' needs an 'sp' mesh axis of "
+                f"size >= 2 (or -1), got mesh {mesh}"
+            )
+        T = train["burn_in_steps"] + train["forward_steps"]
+        if sp > 0 and T % sp:
+            raise ValueError(
+                f"train_args.seq_attention: 'ring' window {T} (burn_in_steps "
+                f"+ forward_steps) must be divisible by mesh sp={sp}"
+            )
     if train["compute_dtype"] not in ("float32", "bfloat16"):
         raise ValueError(
             f"train_args.compute_dtype={train['compute_dtype']!r} "
@@ -396,9 +464,22 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
 
 def normalize_args(raw: Dict[str, Any]) -> Dict[str, Any]:
     """Apply defaults to a raw config dict and validate."""
+    train_raw = dict(raw.get("train_args", {}) or {})
+    # 'attn_mode' is the documented alias for 'seq_attention' (the knob
+    # predates the auto-pick policy); an explicit attn_mode wins, and
+    # setting both to DIFFERENT values is a config contradiction
+    if "attn_mode" in train_raw:
+        mode = train_raw.pop("attn_mode")
+        if train_raw.get("seq_attention", mode) != mode:
+            raise ValueError(
+                f"train_args.attn_mode={mode!r} contradicts "
+                f"train_args.seq_attention={train_raw['seq_attention']!r} "
+                "(attn_mode is an alias; set one)"
+            )
+        train_raw["seq_attention"] = mode
     args = {
         "env_args": copy.deepcopy(raw.get("env_args", {})),
-        "train_args": _deep_merge(DEFAULT_TRAIN_ARGS, raw.get("train_args", {})),
+        "train_args": _deep_merge(DEFAULT_TRAIN_ARGS, train_raw),
         "worker_args": _deep_merge(DEFAULT_WORKER_ARGS, raw.get("worker_args", {})),
     }
     return validate_args(args)

@@ -68,7 +68,7 @@ class CachedSelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x, cache=None, slot=None, count=None, seq: bool = False,
                  key_mask=None, burn_in: int = 0, use_flash: bool = False,
-                 ring_mesh=None):
+                 ring_mesh=None, blk_q: int = 128, blk_k: int = 128):
         H, S = self.n_heads, self.memory_len
         Dh = self.d_model // H
 
@@ -106,6 +106,18 @@ class CachedSelfAttention(nn.Module):
         if key_mask is None:
             key_mask = jnp.ones((B, T), x.dtype)
 
+        # named for the remat ladder (TransformerNet seq mode): under
+        # jax.checkpoint with save_only_these_names('attn_qkv') these
+        # projections — the flash kernel's custom-VJP residuals — stay
+        # materialized while everything else in the block is recomputed,
+        # so the kernel's own chunked backward never waits on a second
+        # dense-projection replay
+        from jax.ad_checkpoint import checkpoint_name
+
+        q = checkpoint_name(q, "attn_qkv")
+        k = checkpoint_name(k, "attn_qkv")
+        v = checkpoint_name(v, "attn_qkv")
+
         # one semantics, three executions: the O(T^2) einsum reference
         # (masked_attention_reference — per-key masks, observed-age ALiBi,
         # ring-window eviction, self always visible), the O(T·blk) Pallas
@@ -119,13 +131,17 @@ class CachedSelfAttention(nn.Module):
             out = masked_ring_self_attention(
                 q, k, v, key_mask, _alibi_slopes(H), ring_mesh, window=S
             )
-        else:
-            if use_flash:
-                from ..ops.flash_attention import masked_flash_attention as attn_fn
-            else:
-                from ..ops.flash_attention import masked_attention_reference as attn_fn
+        elif use_flash:
+            from ..ops.flash_attention import masked_flash_attention
 
-            out = attn_fn(q, k, v, key_mask, _alibi_slopes(H), window=S)
+            out = masked_flash_attention(
+                q, k, v, key_mask, _alibi_slopes(H), window=S,
+                blk_q=blk_q, blk_k=blk_k,
+            )
+        else:
+            from ..ops.flash_attention import masked_attention_reference
+
+            out = masked_attention_reference(q, k, v, key_mask, _alibi_slopes(H), window=S)
         return nn.Dense(self.d_model, name="o")(out.reshape(B, T, H * Dh)), None
 
 
@@ -149,7 +165,8 @@ class TransformerNet(nn.Module):
     @nn.compact
     def __call__(self, obs, hidden=None, train: bool = False, *,
                  seq: bool = False, key_mask=None, burn_in: int = 0,
-                 use_flash: bool = False, ring_mesh=None):
+                 use_flash: bool = False, ring_mesh=None,
+                 remat: str = "none", blk_q: int = 128, blk_k: int = 128):
         if seq:
             x = nn.relu(nn.Dense(self.d_model, name="enc1")(_flatten_obs(obs, 2)))
             slot = count = None
@@ -163,27 +180,76 @@ class TransformerNet(nn.Module):
             slot = jnp.mod(pos, float(self.memory_len)).astype(jnp.int32)
         x = nn.Dense(self.d_model, name="enc2")(x)
 
+        # selective-remat ladder (seq mode only; config: train_args.remat):
+        #   none  — store every activation (fastest backward, most HBM);
+        #   attn  — jax.checkpoint around each attention sublayer: the
+        #           O(T^2) score/softmax tensors (einsum) or the kernel
+        #           forward (flash) recompute in the backward pass;
+        #   block — checkpoint the whole attention+FFN residual block:
+        #           only block inputs (B, T, d) survive per layer, the
+        #           lever that fits T1024 x d1536 in HBM.
+        # Both rungs keep the q/k/v projections — the flash kernel's
+        # custom-VJP residuals, tagged 'attn_qkv' in CachedSelfAttention —
+        # materialized via save_only_these_names, so the kernel's chunked
+        # backward starts from stored operands.  Param names/trees are
+        # unchanged (flax lifted remat), so checkpoints stay compatible
+        # and remat on/off is bit-identical under jit (pinned by
+        # tests/test_transformer.py::test_seq_remat_bit_parity).
+        if seq and remat not in ("none", "attn", "block"):
+            raise ValueError(f"remat={remat!r} not one of ('none', 'attn', 'block')")
+        pol = jax.checkpoint_policies.save_only_these_names("attn_qkv")
+
         new_layers = []
         for i in range(self.n_layers):
-            h = nn.LayerNorm(name=f"ln_a{i}")(x)
-            a, new_cache = CachedSelfAttention(
-                self.d_model, self.n_heads, self.memory_len, name=f"attn{i}"
-            )(
-                h,
-                cache=None if seq else hidden["layers"][i],
-                slot=slot,
-                count=count,
-                seq=seq,
-                key_mask=key_mask,
-                burn_in=burn_in,
-                use_flash=use_flash,
-                ring_mesh=ring_mesh,
-            )
-            x = x + a
-            h = nn.LayerNorm(name=f"ln_m{i}")(x)
-            m = nn.Dense(self.mlp_ratio * self.d_model, name=f"mlp_up{i}")(h)
-            x = x + nn.Dense(self.d_model, name=f"mlp_dn{i}")(nn.relu(m))
-            new_layers.append(new_cache)
+            # one definition of each block half, shared by every rung of
+            # the ladder AND the step path — an edit to the block math
+            # cannot diverge the executions
+            def attn_sub(mdl, h, km, i=i):
+                a, _ = CachedSelfAttention(
+                    self.d_model, self.n_heads, self.memory_len, name=f"attn{i}"
+                )(
+                    h, seq=True, key_mask=km, burn_in=burn_in,
+                    use_flash=use_flash, ring_mesh=ring_mesh,
+                    blk_q=blk_q, blk_k=blk_k,
+                )
+                return a
+
+            def mlp_half(mdl, x, i=i):
+                h = nn.LayerNorm(name=f"ln_m{i}")(x)
+                m = nn.Dense(self.mlp_ratio * self.d_model, name=f"mlp_up{i}")(h)
+                return x + nn.Dense(self.d_model, name=f"mlp_dn{i}")(nn.relu(m))
+
+            def block_fn(mdl, x, km, i=i):
+                h = nn.LayerNorm(name=f"ln_a{i}")(x)
+                return mlp_half(mdl, x + attn_sub(mdl, h, km))
+
+            if not seq:
+                h = nn.LayerNorm(name=f"ln_a{i}")(x)
+                a, new_cache = CachedSelfAttention(
+                    self.d_model, self.n_heads, self.memory_len, name=f"attn{i}"
+                )(
+                    h,
+                    cache=hidden["layers"][i],
+                    slot=slot,
+                    count=count,
+                    seq=False,
+                    key_mask=key_mask,
+                    burn_in=burn_in,
+                    use_flash=use_flash,
+                    ring_mesh=ring_mesh,
+                )
+                x = mlp_half(self, x + a)
+                new_layers.append(new_cache)
+            elif remat == "block":
+                x = nn.remat(block_fn, policy=pol)(self, x, key_mask)
+                new_layers.append(None)
+            elif remat == "attn":
+                h = nn.LayerNorm(name=f"ln_a{i}")(x)
+                x = mlp_half(self, x + nn.remat(attn_sub, policy=pol)(self, h, key_mask))
+                new_layers.append(None)
+            else:
+                x = block_fn(self, x, key_mask)
+                new_layers.append(None)
 
         h = nn.LayerNorm(name="ln_f")(x)
         out: Dict[str, Any] = {

@@ -48,6 +48,22 @@ from .ring_attention import NEG_INF, full_attention_reference
 _LANE = 128
 
 
+def effective_blocks(T: int, blk_q: int, blk_k: int):
+    """The (blk_q, blk_k, Tp) the masked kernel will actually run at for a
+    window of length ``T``: blocks clamp to the 128-lane tile, and T pads
+    up to a common multiple of both blocks.  The startup validation
+    (config.validate_args + TrainContext) enforces power-of-two blocks,
+    which makes the divisibility here hold BY CONSTRUCTION (the smaller
+    power of two divides the larger); anyone relaxing that rule must add
+    an explicit padded-window check against this function, or an invalid
+    tiling will first fail inside the compiled kernel."""
+    blk_q = min(int(blk_q), _LANE)
+    blk_k = min(int(blk_k), _LANE)
+    Tp = -(-T // blk_q) * blk_q
+    Tp = -(-Tp // blk_k) * blk_k
+    return blk_q, blk_k, Tp
+
+
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, blk_q, blk_k, n_k, causal, scale
 ):
@@ -324,10 +340,7 @@ def _masked_flash_forward(q, k, v, key_mask, slopes, window, blk_q, blk_k, inter
     scale = 1.0 / (D ** 0.5)
     counts = jnp.cumsum(key_mask.astype(jnp.float32), axis=1)  # observed count
 
-    blk_q = min(blk_q, _LANE)
-    blk_k = min(blk_k, _LANE)
-    Tp = -(-T // blk_q) * blk_q
-    Tp = -(-Tp // blk_k) * blk_k
+    blk_q, blk_k, Tp = effective_blocks(T, blk_q, blk_k)
 
     def fold(x):
         x = jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)

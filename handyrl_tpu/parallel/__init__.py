@@ -5,7 +5,12 @@ from .mesh import (
     param_shardings,
     replicated_sharding,
 )
-from .train_step import TrainContext, forward_prediction
+from .train_step import (
+    TrainContext,
+    forward_prediction,
+    resolve_seq_attention,
+    resolve_seq_remat,
+)
 from .distributed import (
     init_distributed,
     is_coordinator,
@@ -21,6 +26,8 @@ __all__ = [
     "param_shardings",
     "TrainContext",
     "forward_prediction",
+    "resolve_seq_attention",
+    "resolve_seq_remat",
     "init_distributed",
     "is_coordinator",
     "local_batch_size",

@@ -74,6 +74,60 @@ def _cast_floats(tree, dtype):
     )
 
 
+def resolve_seq_attention(args: Dict[str, Any], T: int) -> str:
+    """THE seq-mode attention auto-pick policy, as one shared resolver
+    ('einsum' | 'flash' | 'ring' for a window of length ``T``) used by the
+    compiled forward, the bench's transformer stages, and the CI smoke —
+    so "which path did the program take" is decided (and reportable) in
+    exactly one place.
+
+    ``auto`` picks the Pallas masked flash kernel for windows >=
+    ``flash_min_t`` and the exact einsum below it.  The crossover is a
+    property of the PROGRAM (the O(T^2) score tensor vs the kernel's fixed
+    launch/block overhead, measured on-chip: einsum wins at T64, flash
+    1.54x at T1024 — BENCH_r05 flash_attention.speedup).  The policy is
+    shared by TPU (compiled kernel) and CPU (exact interpret-mode kernel —
+    CPU long-T runs are tests/smokes on this TPU framework, and sharing
+    the pick is what lets CI exercise the very program the chip compiles);
+    any OTHER backend (e.g. GPU) falls back to einsum under auto, because
+    the interpreter there would be a silent orders-of-magnitude slowdown
+    on what may be a real training run — spell ``flash`` explicitly to
+    override."""
+    mode = args.get("seq_attention", "auto")
+    if mode == "auto":
+        if jax.default_backend() not in ("tpu", "cpu"):
+            return "einsum"
+        return "flash" if T >= int(args.get("flash_min_t", 128)) else "einsum"
+    return mode
+
+
+def resolve_seq_remat(args: Dict[str, Any], T: int) -> str:
+    """The seq-path rung of the remat ladder ('none' | 'attn' | 'block').
+
+    Explicit ladder values pass through; booleans collapse to the nearest
+    rung (True -> 'block', False -> 'none'); ``auto`` turns 'block' on for
+    long windows (T >= 512) on TPU — the d2048 width sweep died to HBM
+    pressure with remat named as the missing lever (bench.py) — and stays
+    'none' elsewhere (short windows fit, and the CPU path prefers speed).
+
+    Ring attention is always 'none': each device already holds only its
+    T/n shard's activations (the ring IS the memory partitioning), and
+    jax.checkpoint around the shard_map ring loop trips shard_map's
+    scan-carry replication typing at trace time (reproduced on jax
+    0.4.37) — the combination is rejected at config time and neutralized
+    here for direct-API callers."""
+    if args.get("seq_attention") == "ring":
+        return "none"
+    v = args.get("remat", "auto")
+    if v in ("none", "attn", "block"):
+        return v
+    # isinstance, not identity/equality: config validation rejects bare
+    # ints, and 1 == True must not silently alias a rung
+    if isinstance(v, bool):
+        return "block" if v else "none"
+    return "block" if jax.default_backend() == "tpu" and T >= 512 else "none"
+
+
 def forward_prediction(module, params, batch: Dict[str, Any], args: Dict[str, Any]) -> Dict[str, Any]:
     """Run the net over a (B, T, P, ...) batch; returns post-burn-in outputs
     of length forward_steps, already turn/action/observation masked.
@@ -124,32 +178,25 @@ def forward_prediction(module, params, batch: Dict[str, Any], args: Dict[str, An
         obs_bp = tree_map(to_bp, obs)                       # (B*P, T, ...)
         km = to_bp(omask)[..., 0]                           # (B*P, T)
         # seq_attention: 'einsum' (exact O(T^2) path), 'flash' (Pallas
-        # masked flash-attention kernel), 'ring' (sequence-parallel masked
-        # ring attention over the mesh's 'sp' axis — args['_mesh'], set by
-        # TrainContext), or 'auto': flash on TPU only when the window is
-        # long enough to amortize the kernel — at short T the O(T^2)
-        # einsum is tiny and XLA-fusable while the Pallas kernel pays
-        # fixed block/launch overhead (the round-4 fp32≈bf16 finding
-        # already showed the d1024/T64 step is not matmul-bound).  The
-        # crossover default is conservative (128, kernel-side bench
-        # crossover from the r3 flash battery: 1.54x at T1024, parity
-        # around T128-256); override with train_args.flash_min_t, and the
-        # armed on-chip comparison (tools/tune_transformer.py
-        # d1024_B64_T64_{bf16,einsum}) re-pins it when the lease allows.
-        mode = args.get("seq_attention", "auto")
-        if mode == "auto" and jax.default_backend() == "tpu":
-            use_flash = T >= int(args.get("flash_min_t", 128))
-        else:
-            use_flash = mode == "flash"
+        # masked flash-attention kernel, blk_q/blk_k block-size knobs),
+        # 'ring' (sequence-parallel masked ring attention over the mesh's
+        # 'sp' axis — args['_mesh'], set by TrainContext), or 'auto'
+        # (flash at T >= flash_min_t, einsum below — see
+        # resolve_seq_attention, the single shared policy).  The remat
+        # ladder (resolve_seq_remat: 'none'/'attn'/'block') rides the same
+        # call: checkpointed blocks trade ~1 extra forward for ~n_layers x
+        # less live activation HBM at long T.
+        mode = resolve_seq_attention(args, T)
         ring_mesh = None
         if mode == "ring":
             # mesh shape + T divisibility are validated up front by
             # TrainContext.__init__ (fail-fast); args['_mesh'] is set there
             ring_mesh = args.get("_mesh")
-            use_flash = False
         outs = module.apply(
             {"params": params}, obs_bp, None, seq=True, key_mask=km,
-            burn_in=burn_in, use_flash=use_flash, ring_mesh=ring_mesh,
+            burn_in=burn_in, use_flash=mode == "flash", ring_mesh=ring_mesh,
+            remat=resolve_seq_remat(args, T),
+            blk_q=int(args.get("blk_q", 128)), blk_k=int(args.get("blk_k", 128)),
         )
         outputs = {
             k: jnp.moveaxis(v.reshape((B, P1, T) + v.shape[2:]), 1, 2)[:, burn_in:]
@@ -203,7 +250,11 @@ def forward_prediction(module, params, batch: Dict[str, Any], args: Dict[str, An
         on_cpu = jax.default_backend() == "cpu"
         mesh = args.get("_mesh")
         one_dev = mesh is None or mesh.size == 1
-        if _auto_flag(args, "remat", not on_cpu):
+        # the seq-path remat LADDER strings collapse to on/off here: the
+        # scan body has no attention/FFN split to checkpoint selectively
+        rv = args.get("remat", "auto")
+        rv = {"none": False, "attn": True, "block": True}.get(rv, rv)
+        if _auto_flag({"remat": rv}, "remat", not on_cpu):
             step = jax.checkpoint(step)
         unroll = _auto_flag(args, "unroll", on_cpu and one_dev)
 
@@ -286,6 +337,31 @@ class TrainContext:
                     f"seq_attention='ring': window length {T} (burn_in_steps "
                     f"+ forward_steps) must be divisible by the 'sp' axis "
                     f"size {sp}"
+                )
+        # fail-fast geometry checks for the seq attention paths (same
+        # construction-time-loudness contract as the ring checks above)
+        if getattr(module, "supports_seq", False) and args.get("seq_forward", True):
+            # same rule as config.validate_args, re-checked here for
+            # direct-API callers that never pass through normalize_args —
+            # the two layers must not drift into different constraints.
+            # Power-of-two blocks make the padded-window divisibility of
+            # ops.flash_attention.effective_blocks hold by construction
+            # (the smaller power of two divides the larger).
+            for name in ("blk_q", "blk_k"):
+                b = int(args.get(name, 128))
+                if b < 8 or (b & (b - 1)):
+                    raise ValueError(
+                        f"{name} must be a power of two >= 8, got {b}"
+                    )
+            if args.get("seq_attention") == "ring" and args.get("remat") in (
+                "attn", "block", True,
+            ):
+                raise ValueError(
+                    "remat ladder is unsupported with seq_attention='ring': "
+                    "the ring already partitions activation memory over "
+                    "'sp', and jax.checkpoint around the shard_map ring "
+                    "loop fails shard_map's scan-carry replication typing "
+                    "— set remat: none/auto"
                 )
         # fail fast at construction, not mid-training in a learner thread:
         # under turn-based training, stateful models (RNN hidden or

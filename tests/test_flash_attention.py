@@ -109,6 +109,72 @@ def test_masked_flash_gradients():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize(
+    "T,window",
+    [
+        (512, 200),
+        # the T1024 point rides the slow leg: interpret-mode kernel cost
+        # grows with the tile grid, and the T512 point already exercises
+        # every code path (multi-tile grid, eviction window, ragged mask)
+        pytest.param(1024, 384, marks=pytest.mark.slow),
+    ],
+)
+def test_masked_flash_long_window_golden(T, window):
+    """The production long-context configuration — T512/T1024 windows,
+    ragged observation masks, ALiBi slopes, a non-default eviction window
+    — forward AND custom-VJP gradients vs the exact einsum reference
+    (interpret-mode kernel on CPU).  This is the shape regime the
+    transformer_long bench drives on-chip; the golden pin here keeps the
+    kernel exact where it is about to be trusted for training."""
+    q, k, v, key_mask, slopes = _masked_case(13 + T % 7, 1, T, 2, 16, 0.7)
+
+    out = masked_flash_attention(q, k, v, key_mask, slopes, window=window)
+    ref = masked_attention_reference(q, k, v, key_mask, slopes, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def loss_flash(q, k, v):
+        return (
+            masked_flash_attention(q, k, v, key_mask, slopes, window=window) ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (
+            masked_attention_reference(q, k, v, key_mask, slopes, window=window) ** 2
+        ).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_masked_flash_custom_blocks():
+    """blk_q/blk_k are config knobs now (train_args.blk_q/blk_k): a
+    non-default tiling must compute the identical function, including at
+    block sizes that force multi-tile grids and padded windows."""
+    q, k, v, key_mask, slopes = _masked_case(21, 2, 192, 2, 16, 0.8)
+    ref = masked_attention_reference(q, k, v, key_mask, slopes, window=24)
+    for blk_q, blk_k in ((32, 64), (64, 32), (128, 128)):
+        out = masked_flash_attention(
+            q, k, v, key_mask, slopes, window=24, blk_q=blk_q, blk_k=blk_k
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"blk_q={blk_q} blk_k={blk_k}",
+        )
+
+
+def test_effective_blocks_single_source_of_truth():
+    from handyrl_tpu.ops.flash_attention import effective_blocks
+
+    assert effective_blocks(100, 128, 128) == (128, 128, 128)
+    assert effective_blocks(192, 64, 32) == (64, 32, 192)
+    assert effective_blocks(8, 256, 256) == (128, 128, 128)
+    for T in (8, 100, 512, 1000):
+        bq, bk, Tp = effective_blocks(T, 64, 128)
+        assert Tp % bq == 0 and Tp % bk == 0 and Tp >= T
+
+
 def test_masked_flash_bf16():
     """compute_dtype=bfloat16 sends bf16 q/k/v through the masked kernel;
     scores accumulate fp32 either way, so outputs track the fp32 einsum
