@@ -206,6 +206,16 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     # TPU, else 'none').  For RNN scan training the ladder collapses to
     # on/off over the scan body (the historical remat: auto|true|false)
     "remat": "auto",
+    # feed-forward models with burn_in_steps 0 slice the training
+    # observation to the live prefix of the T axis — numerically identical,
+    # skips compute on end-of-episode padding; disable when debugging
+    # shape/recompile issues (parallel/train_step.py _ff_compact)
+    "compact_padding": True,
+    # fully unroll the RNN training scan over T: 'auto' = on for
+    # single-device CPU (XLA:CPU runs while-loop bodies without its fast
+    # kernel runtime), off for TPU and multi-device meshes (unrolled
+    # bodies explode SPMD-partitioner compile time)
+    "unroll": "auto",
     # 'bfloat16' runs the forward/backward compute in bf16 (MXU rate)
     # with fp32 master weights; 'float32' is exact
     "compute_dtype": "float32",
@@ -229,6 +239,9 @@ DEFAULT_WORKER_ARGS: Dict[str, Any] = {
     # bound on consecutive failed sessions before giving up (-1 = forever,
     # the right default for a fleet behind a supervisor)
     "max_rejoins": -1,
+    # how long each entry attempt keeps retrying the TCP connect (server
+    # still booting / restarting) before counting as a failed session
+    "entry_retry_seconds": 60.0,
 }
 
 VALID_TARGETS = ("MC", "TD", "UPGO", "VTRACE")
@@ -415,6 +428,16 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
             f"train_args.remat={rv!r} not one of "
             "('auto', true, false, 'none', 'attn', 'block')"
         )
+    uv = train["unroll"]
+    if not (isinstance(uv, bool) or uv in ("auto", None)):
+        raise ValueError(
+            f"train_args.unroll={uv!r} not one of ('auto', true, false)"
+        )
+    if not isinstance(train["compact_padding"], bool):
+        raise ValueError(
+            f"train_args.compact_padding={train['compact_padding']!r} "
+            "must be a bool"
+        )
     mesh = train["mesh"]
     if not isinstance(mesh, dict) or not mesh:
         raise ValueError("train_args.mesh must be a non-empty axis->size dict")
@@ -457,6 +480,9 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         )
     if train["lr_scale"] <= 0:
         raise ValueError(f"train_args.lr_scale must be > 0, got {train['lr_scale']}")
+    worker_args = args.get("worker_args", {})
+    if worker_args and float(worker_args.get("entry_retry_seconds", 60.0)) <= 0:
+        raise ValueError("worker_args.entry_retry_seconds must be > 0")
     if "env" not in args.get("env_args", {}):
         raise ValueError("env_args.env is required")
     return args

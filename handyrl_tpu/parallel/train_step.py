@@ -526,9 +526,16 @@ class TrainContext:
         produced array; because the train step donates its state
         (``donate_argnums=(0,)``), an aliased layout would delete the
         caller's arrays on the first update.  A jitted identity always
-        materializes fresh outputs, so the caller keeps ownership."""
+        materializes fresh outputs, so the caller keeps ownership.
+
+        The layout put is a multi-device program like any other, and this
+        path also runs MID-RUN (sentinel rollback re-lays params while the
+        rollout thread keeps dispatching) — so it takes the mesh's
+        dispatch locks itself.  Callers must NOT wrap it again: the
+        per-device locks are not reentrant."""
         shardings = param_shardings(self.mesh, tree)
-        return jax.jit(lambda t: t, out_shardings=shardings)(tree)
+        put = jax.jit(lambda t: t, out_shardings=shardings)
+        return dispatch_serialized(lambda: put(tree), self.mesh)
 
     def _bind(self, state):
         """Compile the train step with the state layout pinned on both sides
@@ -547,13 +554,16 @@ class TrainContext:
     def init_state(self, params) -> Dict[str, Any]:
         params = self._fresh_put(params)
         # optimizer moments inherit the params' layout (same shape-based
-        # 'mp' rule, pinned so the state enters _bind's layout exactly)
-        opt_state = jax.jit(
+        # 'mp' rule, pinned so the state enters _bind's layout exactly);
+        # dispatched under the mesh's locks like _fresh_put — init_state
+        # runs mid-run on a sentinel rollback
+        init = jax.jit(
             self.tx.init,
             out_shardings=param_shardings(
                 self.mesh, jax.eval_shape(self.tx.init, params)
             ),
-        )(params)
+        )
+        opt_state = dispatch_serialized(lambda: init(params), self.mesh)
         return {
             "params": params,
             "opt_state": opt_state,

@@ -212,7 +212,14 @@ class DeviceReplay:
             "g": jnp.zeros((), jnp.int32),
         }
         sharding = _lane_sharding(self.mesh, rings)
-        return jax.jit(lambda t: t, out_shardings=sharding)(rings), sharding
+        from ..parallel.mesh import dispatch_serialized
+
+        # the first-ingest layout put is a multi-device program dispatched
+        # from the rollout thread — lock it like every other dispatch (the
+        # trainer cannot be stepping yet with an empty ring, but a split
+        # plane's learner mesh may be busy with other programs)
+        put = jax.jit(lambda t: t, out_shardings=sharding)
+        return dispatch_serialized(lambda: put(rings), self.mesh), sharding
 
     # -- ingest -------------------------------------------------------------
 
@@ -321,6 +328,7 @@ class DeviceReplay:
     def _account(self, dev_stats) -> Dict[str, Any]:
         """Host-fetch one ingest's stats and fold them into the cumulative
         counters (blocks until that ingest has executed)."""
+        # graftlint: allow[HS001] reason=THE deferred-fetch point: callers defer this one dispatch behind the next enqueue (ingest_counted defer=True), so it overlaps execution instead of serializing the rollout thread
         stats = tree_map(np.asarray, jax.device_get(dev_stats))
         self.counters["episodes"] += int(stats["episodes"])
         self.counters["game_steps"] += int(stats["game_steps"])
@@ -392,6 +400,7 @@ class DeviceReplay:
                 self.args.get("burn_in_steps", 0),
             ).sum()
 
+        # graftlint: allow[HS001] reason=documented host sync: warmup gate only, called before the first train step / sparingly, never per step
         return int(jax.device_get(dispatch_serialized(_count, self.mesh)))
 
     # -- sample + train -----------------------------------------------------

@@ -156,7 +156,19 @@ class DeviceRollout:
         self._fn = build_selfplay_fn(venv, module, n_games)
 
     def generate(self, params, key) -> List[Dict[str, Any]]:
-        cols = self._fn(params, key)
+        from ..parallel.mesh import dispatch_serialized
+
+        # the episodic program is unsharded (it commits to the default
+        # device), but the rollout thread dispatches it CONCURRENTLY with
+        # sharded train steps whose device set includes that device — the
+        # enqueue needs the same per-device program order as every other
+        # dispatch site (the device scope is exactly the one device)
+        cols = dispatch_serialized(
+            lambda: self._fn(params, key), jax.devices()[:1]
+        )
+        # whole-horizon episodic fetch: this driver's contract IS one
+        # host round-trip per batch of finished games
+        # graftlint: allow[HS001] reason=episodic driver fetches one whole-horizon batch per call by design
         return columns_to_episodes(jax.device_get(cols), self.venv, self.args)
 
 
@@ -463,6 +475,7 @@ class StreamingDeviceRollout:
         record, self._pending = self._pending, record
         if record is None:
             return []
+        # graftlint: allow[HS001] reason=one-call-pipelined fetch: block N-1's transfer overlaps block N's device compute (the dispatch above is async)
         record = _jax.device_get(record)
 
         active = record["active"]                    # (K, B, P)

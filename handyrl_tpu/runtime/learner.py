@@ -1129,7 +1129,9 @@ class Learner:
                     "players": self._venv.num_players,
                     "model_id": stats_epoch,
                     "game_steps": pending_steps,
+                    # graftlint: allow[HS001] reason=stats are host numpy from the deferred ingest fetch (one dispatch old), not device values
                     "outcome_sum": float(stats["outcome_sum"].sum()),
+                    # graftlint: allow[HS001] reason=stats are host numpy from the deferred ingest fetch (one dispatch old), not device values
                     "outcome_sq_sum": float(stats["outcome_sq_sum"]),
                 }
                 pending_steps = 0

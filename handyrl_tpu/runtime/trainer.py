@@ -516,7 +516,6 @@ class Trainer:
         corrupt manifest) keeps the current params — the in-step skip
         already prevents poisoning, so continuing is safe — and resets
         the streak so the decision is re-evaluated on fresh evidence."""
-        from ..parallel.mesh import dispatch_serialized
         from . import checkpoint as ckpt
 
         self._sentinel_streak = 0
@@ -543,15 +542,15 @@ class Trainer:
             model_dir, epoch, self.state_host["params"], pre_verified=True
         )
         # init_state dispatches multi-device layout programs; mid-run the
-        # rollout thread may be dispatching concurrently, so take the
-        # learner mesh's locks like every other multi-device program
-        state = dispatch_serialized(
-            lambda: self.ctx.init_state(params), self.ctx.mesh
-        )
+        # rollout thread may be dispatching concurrently — init_state now
+        # takes the learner mesh's locks per program itself (the locks are
+        # not reentrant, so wrapping it here again would deadlock)
+        state = self.ctx.init_state(params)
         state["steps"] = jax.device_put(
             np.int32(self.steps), self.ctx._replicated
         )
         self.state = state
+        # graftlint: allow[HS001] reason=rollback is a rare recovery path; the host snapshot is what checkpoints/drains read
         self.state_host = jax.device_get(state)
         self.sentinel_events["sentinel_rollbacks"] += 1
         # jump the sampling stream far from the one that fed the poison
@@ -589,6 +588,7 @@ class Trainer:
                 self._replay_key, sub = jax.random.split(self._replay_key)
                 self.state, metrics = train(self.state, sub, self._step_lr(lr, fused))
                 if metric_accum:
+                    # graftlint: allow[HS001] reason=deliberate one-deep pipelining: block on update N-1 so the dispatch queue stays shallow and the concurrent rollout thread gets device time
                     jax.block_until_ready(metric_accum[-1]["total"])
                 metric_accum.append(metrics)
                 batch_cnt += fused
@@ -639,6 +639,7 @@ class Trainer:
         if not metric_accum:
             return self.state_host["params"]
 
+        # graftlint: allow[HS001] reason=epoch-end fetch of the whole epoch's metrics in one device_get — once per epoch, not per dispatch
         fetched = jax.device_get(metric_accum)
         skipped_steps = 0
         if self.sentinel:
@@ -720,6 +721,7 @@ class Trainer:
             self.data_cnt_ema = (
                 self.data_cnt_ema * 0.8 + data_cnt / (1e-2 + applied_cnt) * 0.2
             )
+        # graftlint: allow[HS001] reason=epoch-boundary host snapshot: the device state is donated every step, so checkpoint/publish readers need this copy
         self.state_host = jax.device_get(self.state)
         return self.state_host["params"]
 

@@ -1,5 +1,6 @@
-from .metrics import read_metrics
+from .metrics import METRIC_KEY_PREFIXES, METRIC_KEYS, read_metrics
 from .platform import apply_platform_override
+from .sanitizers import HostSyncSanitizer, RecompileSentinel
 from .tree import (
     tree_map,
     tree_stack,
@@ -13,6 +14,10 @@ from .tree import (
 __all__ = [
     "apply_platform_override",
     "read_metrics",
+    "METRIC_KEYS",
+    "METRIC_KEY_PREFIXES",
+    "HostSyncSanitizer",
+    "RecompileSentinel",
     "tree_map",
     "tree_stack",
     "tree_unstack",

@@ -16,6 +16,30 @@ import json
 import sys
 from typing import Any, Dict, List
 
+# The metrics.jsonl KEY REGISTRY — the tolerance contract between the
+# writers (Learner.update -> _write_metrics, Trainer.stats) and every
+# reader (scripts/_logparse.py + the plot scripts, tools/ablate_*).
+# graftlint rule MET006 statically checks both sides against this set:
+# a writer emitting an unregistered key, or a consumer reading one, is a
+# lint finding — so "will every reader tolerate this record" is reviewed
+# HERE, once, instead of per call site.  Readers must treat every key as
+# optional (records predate keys; null values are legal — win_rate /
+# generation_mean are explicitly null on empty epochs).
+METRIC_KEYS = frozenset({
+    # identity / cadence
+    "epoch", "steps", "episodes", "episodes_per_sec", "updates_per_sec",
+    # evaluation / generation books
+    "win_rate", "eval_games", "generation_mean", "generation_std",
+    # trainer loop
+    "loss", "train_steps_per_sec", "input_wait_frac", "input_wait_warmup_s",
+    "mfu", "device_mean_episode_len",
+    # live pipeline / plane topology
+    "pipeline", "plane",
+})
+# key families written from the *_KEYS tuples (trainer/learner) and the
+# per-epoch plane-health diffs; one prefix registers the family
+METRIC_KEY_PREFIXES = ("pipe_", "plane_", "sentinel_")
+
 
 def read_metrics(path: str, strict: bool = False) -> List[Dict[str, Any]]:
     """Parse a metrics.jsonl into a list of records.

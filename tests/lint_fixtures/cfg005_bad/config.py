@@ -1,0 +1,12 @@
+"""CFG005 bad fixture: one undocumented knob, one stale docs row."""
+
+DEFAULT_TRAIN_ARGS = {
+    "gamma": 0.8,
+    "undocumented_knob": 1,
+    "worker": {"num_parallel": 2},
+    "mesh": {"dp": -1},
+}
+
+DEFAULT_WORKER_ARGS = {
+    "server_address": "",
+}

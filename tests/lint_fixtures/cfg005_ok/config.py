@@ -1,0 +1,11 @@
+"""CFG005 ok fixture: defaults and docs in two-way parity."""
+
+DEFAULT_TRAIN_ARGS = {
+    "gamma": 0.8,
+    "worker": {"num_parallel": 2},
+    "mesh": {"dp": -1},
+}
+
+DEFAULT_WORKER_ARGS = {
+    "server_address": "",
+}

@@ -1,0 +1,44 @@
+"""DL002 fixture: compiled-call dispatch sites vs the dispatch locks."""
+
+import jax
+
+from handyrl_tpu.parallel.mesh import dispatch_serialized
+
+
+def make_fn():
+    def f(x):
+        return x
+
+    return jax.jit(f)          # marks make_fn as a jit factory
+
+
+class Roll:
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._fn = make_fn()                  # factory-bound target
+        self._step = jax.jit(lambda x: x)     # directly jit-bound target
+
+    def bad(self, x):
+        y = self._step(x)                     # DL002: unwrapped
+        z = self._fn(x)                       # DL002: unwrapped (factory)
+        w = jax.jit(lambda t: t)(x)           # DL002: immediate invocation
+        return y, z, w
+
+    def bad_scope(self, x):
+        return dispatch_serialized(lambda: self._step(x))        # DL002: no scope
+
+    def bad_none(self, x):
+        return dispatch_serialized(lambda: self._step(x), None)  # DL002: None scope
+
+    def good_lambda(self, x):
+        return dispatch_serialized(lambda: self._step(x), self.mesh)
+
+    def good_def(self, x):
+        def _run():
+            return self._fn(x)
+
+        return dispatch_serialized(_run, self.mesh)
+
+    def good_pragma(self, x):
+        # graftlint: allow[DL002] reason=construction-time layout put, runs before any concurrent dispatcher exists
+        return jax.jit(lambda t: t)(x)

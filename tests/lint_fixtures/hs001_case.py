@@ -1,0 +1,53 @@
+"""HS001 fixture: blocking host syncs in a hot-loop module.
+
+Parsed (never imported) by tests/test_graftlint.py with this file
+configured as a hot-loop module.  MUST-trigger sites are tagged in
+comments; everything else MUST NOT trigger.
+"""
+
+import jax
+import numpy as np
+
+
+def hot_loop_bad(ctx, state, batches):
+    for batch in batches:
+        state, metrics = ctx.train_step(state, batch, 1e-3)
+        jax.block_until_ready(metrics)             # HS001: always-on
+        fetched = jax.device_get(metrics)          # HS001: always-on
+        loss = metrics["total"].item()             # HS001: always-on
+        arr = np.asarray(fetched)                  # HS001: dispatching loop
+        val = float(loss)                          # HS001: dispatching loop
+    return state, arr, val
+
+
+def non_dispatching_loop_ok(rows):
+    out = []
+    for row in rows:
+        # float()/np.asarray of host values in a loop that never
+        # dispatches: not a per-dispatch sync
+        out.append(float(row) + np.asarray(row).sum())
+    return out
+
+
+def epoch_end_ok(metrics):
+    # outside any loop: float()/asarray are only loop-scoped primitives
+    return float(np.asarray(metrics).sum())
+
+
+def drain(pending):
+    # allowlisted teardown path: the block is the POINT here
+    jax.block_until_ready(pending)
+
+
+class Plane:
+    def __init__(self, state):
+        # allowlisted construction path
+        self.state_host = jax.device_get(state)
+
+    def stop(self):
+        jax.block_until_ready(self.state_host)
+
+
+def pragma_ok(metrics):
+    # graftlint: allow[HS001] reason=epoch-end fetch, once per epoch
+    return jax.device_get(metrics)

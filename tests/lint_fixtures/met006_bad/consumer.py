@@ -1,0 +1,12 @@
+"""MET006 bad-fixture consumer: reads one key no writer registers."""
+
+from handyrl_tpu.utils.metrics import read_metrics
+
+
+def main(path):
+    records = [r for r in read_metrics(path) if r.get("loss")]
+    out = []
+    for rec in records:
+        out.append(rec["epoch"])
+        out.append(rec.get("bogus_key"))        # MET006
+    return out

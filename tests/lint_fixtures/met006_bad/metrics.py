@@ -1,0 +1,4 @@
+"""MET006 bad-fixture registry."""
+
+METRIC_KEYS = frozenset({"epoch", "loss", "steps"})
+METRIC_KEY_PREFIXES = ("pipe_",)

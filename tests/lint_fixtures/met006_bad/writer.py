@@ -1,0 +1,16 @@
+"""MET006 bad-fixture writer: one unregistered key, one bad tuple key."""
+
+PIPE_STAT_KEYS = ("sample_s", "assemble_s")
+SENTINEL_EVENT_KEYS = ("unregistered_event",)   # MET006 via tuple
+
+
+class W:
+    def update(self):
+        record = {"epoch": 0}
+        record["loss"] = 0.5
+        record["unregistered_key"] = 2          # MET006
+        record.update(steps=3)
+        self.stats["pipe_sample_s"] = 0.1       # ok: registered prefix
+        for key in PIPE_STAT_KEYS:
+            self.stats["pipe_" + key] = 0.0     # ok: literal prefix
+        self._write_metrics(record)

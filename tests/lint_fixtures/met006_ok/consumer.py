@@ -1,0 +1,8 @@
+"""MET006 ok-fixture consumer: reads only registered keys."""
+
+from handyrl_tpu.utils.metrics import read_metrics
+
+
+def main(path):
+    records = [r for r in read_metrics(path) if r.get("loss")]
+    return [(rec["epoch"], rec.get("pipe_sample_s")) for rec in records]

@@ -1,0 +1,4 @@
+"""MET006 ok-fixture registry."""
+
+METRIC_KEYS = frozenset({"epoch", "loss", "steps", "sentinel_rollbacks"})
+METRIC_KEY_PREFIXES = ("pipe_",)

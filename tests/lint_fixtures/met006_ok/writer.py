@@ -1,0 +1,15 @@
+"""MET006 ok-fixture writer: every key registered."""
+
+PIPE_STAT_KEYS = ("sample_s", "assemble_s")
+SENTINEL_EVENT_KEYS = ("sentinel_rollbacks",)
+
+
+class W:
+    def update(self):
+        record = {"epoch": 0}
+        record["loss"] = 0.5
+        record.update(steps=3)
+        self.stats["pipe_sample_s"] = 0.1
+        for key in PIPE_STAT_KEYS:
+            self.stats["pipe_" + key] = 0.0
+        self._write_metrics(record)

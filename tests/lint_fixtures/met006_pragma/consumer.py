@@ -1,0 +1,15 @@
+"""MET006 pragma-fixture consumer: the escape hatch works in
+contract-rule files too (consumers are not in the scanned path set)."""
+
+from handyrl_tpu.utils.metrics import read_metrics
+
+
+def main(path):
+    records = [r for r in read_metrics(path) if r.get("loss")]
+    out = []
+    for rec in records:
+        # graftlint: allow[MET006] reason=transitional key, writer lands next PR
+        out.append(rec.get("transitional_key"))
+        # graftlint: allow[MET006]
+        out.append(rec.get("reasonless_key"))
+    return out

@@ -1,0 +1,4 @@
+"""MET006 pragma-fixture registry."""
+
+METRIC_KEYS = frozenset({"epoch", "loss"})
+METRIC_KEY_PREFIXES = ("pipe_",)

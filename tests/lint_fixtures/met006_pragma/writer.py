@@ -1,0 +1,8 @@
+"""MET006 pragma-fixture writer: clean."""
+
+
+class W:
+    def update(self):
+        record = {"epoch": 0}
+        record["loss"] = 0.5
+        self._write_metrics(record)

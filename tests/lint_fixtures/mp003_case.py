@@ -1,0 +1,39 @@
+"""MP003 fixture: mp primitives in batcher-child code paths."""
+
+import multiprocessing as mp
+import os
+import queue as thqueue
+
+
+def _child_bad(free_q, stop):
+    evt = mp.Event()                      # MP003: mp primitive in child
+    while not evt.is_set():               # MP003: lock-holding accessor
+        if free_q.qsize() > 0:            # MP003: lock-holding accessor
+            free_q.get()
+
+
+def _child_helper(unused):
+    return mp.Queue()                     # MP003: reached via _child_chain
+
+
+def _child_chain():
+    _child_helper(None)
+
+
+def _child_ok(free_q, stop, ready_w):
+    while not stop.value:                 # lock-free raw Value: allowed
+        try:
+            free_q.get(timeout=0.2)       # private per-child queue: allowed
+        except thqueue.Empty:
+            continue
+        os.write(ready_w, b"x")           # raw pipe write: allowed
+
+
+def parent():
+    # parent-side construction is fine — the rule covers CHILD code paths
+    q = mp.Queue()
+    stop = mp.Value("i", 0, lock=False)
+    p1 = mp.Process(target=_child_bad, args=(q, stop))
+    p2 = mp.Process(target=_child_ok, args=(q, stop, 1))
+    p3 = mp.Process(target=_child_chain)
+    return p1, p2, p3

@@ -1,0 +1,17 @@
+"""Pragma fixture: suppression with a reason vs a reasonless pragma."""
+
+import jax
+
+
+def suppressed_trailing(metrics):
+    return jax.device_get(metrics)  # graftlint: allow[HS001] reason=unit-test window fetch
+
+
+def suppressed_above(metrics):
+    # graftlint: allow[HS001] reason=unit-test window fetch
+    return jax.device_get(metrics)
+
+
+def reasonless(metrics):
+    # graftlint: allow[HS001]
+    return jax.device_get(metrics)

@@ -1,0 +1,36 @@
+"""RNG004 fixture: PRNG keys consumed twice without a split."""
+
+import jax
+
+
+def double_use_bad(params, fn):
+    key = jax.random.PRNGKey(0)
+    a = fn(params, key)                    # first consumption
+    b = jax.random.normal(key, (3,))       # RNG004: second consumption
+    return a, b
+
+
+def split_ok(params, fn):
+    key = jax.random.PRNGKey(0)
+    key, sub = jax.random.split(key)
+    a = fn(params, sub)
+    key, sub = jax.random.split(key)
+    b = jax.random.normal(sub, (3,))
+    return a, b, key
+
+
+def branch_ok(params, fn, flag):
+    # one consumption per branch: only one branch runs
+    key = jax.random.PRNGKey(0)
+    if flag:
+        return fn(params, key)
+    return jax.random.normal(key, (3,))
+
+
+def loop_rebind_ok(params, fn, n):
+    key = jax.random.PRNGKey(0)
+    out = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        out.append(fn(params, sub))
+    return out

@@ -1,0 +1,268 @@
+"""graftlint (tools/graftlint) — rule fixtures, pragmas, baselines, and
+the repo self-gate.
+
+Every rule has a must-trigger and a must-not-trigger fixture under
+tests/lint_fixtures/ (fixtures are PARSED, never imported).  The final
+tests run the real configuration over handyrl_tpu/ — the acceptance
+gate: the tree lints clean with an empty HS001/DL002/MP003 baseline.
+"""
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import (
+    LintConfig,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
+
+
+def _fixture_config(**overrides) -> LintConfig:
+    cfg = LintConfig(root=FIXTURES)
+    for key, value in overrides.items():
+        setattr(cfg, key, value)
+    return cfg
+
+
+def _rules(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# -- HS001 --------------------------------------------------------------------
+
+
+def test_hs001_triggers_and_boundaries():
+    cfg = _fixture_config(hs001_modules=("hs001_case.py",))
+    findings = run_lint(cfg, ["hs001_case.py"], rules=["HS001"])
+    hs = _rules(findings, "HS001")
+    # exactly the five tagged sites in hot_loop_bad: block_until_ready,
+    # device_get, .item(), asarray-in-dispatching-loop, float-in-loop
+    assert len(hs) == 5, [f.format() for f in hs]
+    src = (FIXTURES / "hs001_case.py").read_text().splitlines()
+    assert all("# HS001" in src[f.line - 1] for f in hs), [f.format() for f in hs]
+    kinds = " ".join(f.message for f in hs)
+    for needle in ("block_until_ready", "device_get", ".item()", "np.asarray", "float()"):
+        assert needle in kinds
+    # the pragma'd site and allowlisted funcs produced nothing
+    assert not any(f.line > 30 for f in hs), [f.format() for f in hs]
+
+
+def test_hs001_scope_is_module_list():
+    # same file NOT configured as a hot module -> no findings
+    cfg = _fixture_config(hs001_modules=("some/other/module.py",))
+    findings = run_lint(cfg, ["hs001_case.py"], rules=["HS001"])
+    assert findings == []
+
+
+# -- DL002 --------------------------------------------------------------------
+
+
+def test_dl002_triggers_and_guards():
+    cfg = _fixture_config(dl002_modules=("dl002_case.py",))
+    findings = run_lint(cfg, ["dl002_case.py"], rules=["DL002"])
+    dl = _rules(findings, "DL002")
+    # bad(): 3 unwrapped sites; bad_scope(): missing scope; bad_none():
+    # explicit None scope — the good_* variants stay silent
+    assert len(dl) == 5, [f.format() for f in dl]
+    messages = " ".join(f.message for f in dl)
+    assert "self._step(...)" in messages
+    assert "self._fn(...)" in messages          # factory-bound target
+    assert "jax.jit(...)(...)" in messages      # immediate invocation
+    assert "explicit device scope" in messages
+    src = (FIXTURES / "dl002_case.py").read_text().splitlines()
+    for f in dl:
+        assert "good" not in src[f.line - 1], f.format()
+
+
+# -- MP003 --------------------------------------------------------------------
+
+
+def test_mp003_child_closure():
+    cfg = _fixture_config()
+    findings = run_lint(cfg, ["mp003_case.py"], rules=["MP003"])
+    mp3 = _rules(findings, "MP003")
+    # _child_bad: Event + is_set + qsize; _child_helper (via the
+    # _child_chain closure): Queue — parent() and _child_ok are silent
+    assert len(mp3) == 4, [f.format() for f in mp3]
+    messages = " ".join(f.message for f in mp3)
+    assert "mp.Event" in messages and "mp.Queue" in messages
+    assert ".is_set()" in messages and ".qsize()" in messages
+    assert not any("parent" in f.message for f in mp3)
+
+
+# -- RNG004 -------------------------------------------------------------------
+
+
+def test_rng004_double_use_only():
+    cfg = _fixture_config()
+    findings = run_lint(cfg, ["rng004_case.py"], rules=["RNG004"])
+    rng = _rules(findings, "RNG004")
+    assert len(rng) == 1, [f.format() for f in rng]
+    assert "'key'" in rng[0].message
+    src = (FIXTURES / "rng004_case.py").read_text().splitlines()
+    assert "RNG004" in src[rng[0].line - 1]  # lands on the tagged line
+
+
+# -- pragmas ------------------------------------------------------------------
+
+
+def test_pragma_suppresses_and_reasonless_pragma_reports():
+    cfg = _fixture_config(hs001_modules=("pragma_case.py",))
+    findings = run_lint(cfg, ["pragma_case.py"], rules=["HS001"])
+    # both reasoned pragmas (trailing + line-above) suppress their HS001;
+    # the reasonless pragma suppresses its target too but surfaces GL000
+    assert _rules(findings, "HS001") == [], [f.format() for f in findings]
+    gl = _rules(findings, "GL000")
+    assert len(gl) == 1, [f.format() for f in findings]
+    assert "no reason=" in gl[0].message
+
+
+# -- CFG005 -------------------------------------------------------------------
+
+
+def test_cfg005_both_directions():
+    cfg = _fixture_config(
+        cfg005_config="cfg005_bad/config.py",
+        cfg005_docs="cfg005_bad/docs/parameters.md",
+    )
+    findings = run_lint(cfg, [], rules=["CFG005"])
+    msgs = [f.message for f in _rules(findings, "CFG005")]
+    assert len(msgs) == 2, msgs
+    assert any("undocumented_knob" in m and "no docs" in m for m in msgs)
+    assert any("stale_row" in m for m in msgs)
+
+
+def test_cfg005_clean_with_alias():
+    cfg = _fixture_config(
+        cfg005_config="cfg005_ok/config.py",
+        cfg005_docs="cfg005_ok/docs/parameters.md",
+    )
+    assert run_lint(cfg, [], rules=["CFG005"]) == []
+
+
+# -- MET006 -------------------------------------------------------------------
+
+
+def _met006_config(tree: str) -> LintConfig:
+    return _fixture_config(
+        met006_registry=f"{tree}/metrics.py",
+        met006_writers=(f"{tree}/writer.py",),
+        met006_consumers=(f"{tree}/consumer.py",),
+    )
+
+
+def test_met006_writer_and_consumer_parity():
+    findings = run_lint(_met006_config("met006_bad"), [], rules=["MET006"])
+    msgs = [f.message for f in _rules(findings, "MET006")]
+    assert len(msgs) == 3, msgs
+    assert any("unregistered_key" in m for m in msgs)       # direct write
+    assert any("unregistered_event" in m for m in msgs)     # via *_KEYS tuple
+    assert any("bogus_key" in m and "consumer" in m for m in msgs)
+
+
+def test_met006_clean():
+    assert run_lint(_met006_config("met006_ok"), [], rules=["MET006"]) == []
+
+
+def test_pragmas_work_in_contract_rule_files():
+    """Consumers/writers/docs are NOT in the scanned path set, but the
+    pragma escape hatch (and GL000 enforcement) must still cover them —
+    otherwise contract-rule findings would only be suppressible via the
+    baseline, which is documented as burn-down-only."""
+    findings = run_lint(_met006_config("met006_pragma"), [], rules=["MET006"])
+    assert _rules(findings, "MET006") == [], [f.format() for f in findings]
+    gl = _rules(findings, "GL000")
+    assert len(gl) == 1 and "no reason=" in gl[0].message, (
+        [f.format() for f in findings]
+    )
+
+
+# -- baseline round trip ------------------------------------------------------
+
+
+def test_baseline_roundtrip_and_burn_down(tmp_path):
+    cfg = _fixture_config(hs001_modules=("hs001_case.py",))
+    findings = run_lint(cfg, ["hs001_case.py"], rules=["HS001"])
+    assert findings
+    baseline_path = tmp_path / "baseline.json"
+    write_baseline(baseline_path, findings)
+
+    # same tree + baseline -> everything suppressed, nothing stale
+    again = run_lint(cfg, ["hs001_case.py"], rules=["HS001"])
+    new, suppressed, stale = apply_baseline(again, load_baseline(baseline_path))
+    assert new == [] and len(suppressed) == len(findings) and stale == {}
+
+    # fix one violation -> its fingerprint goes stale (burn-down signal),
+    # and content-addressing keeps the others matched despite line drift
+    fixed_root = tmp_path / "fixed"
+    fixed_root.mkdir()
+    src = (FIXTURES / "hs001_case.py").read_text()
+    src = src.replace("        jax.block_until_ready(metrics)             # HS001: always-on\n", "\n\n")
+    (fixed_root / "hs001_case.py").write_text(src)
+    cfg_fixed = LintConfig(root=fixed_root, hs001_modules=("hs001_case.py",))
+    after = run_lint(cfg_fixed, ["hs001_case.py"], rules=["HS001"])
+    new, suppressed, stale = apply_baseline(after, load_baseline(baseline_path))
+    assert new == [], [f.format() for f in new]
+    assert len(suppressed) == len(findings) - 1
+    assert sum(len(v) for v in stale.values()) == 1
+
+
+# -- the repo self-gate (acceptance criterion) --------------------------------
+
+
+def test_repo_lints_clean():
+    """THE gate: handyrl_tpu/ has zero unsuppressed findings under the
+    real configuration — every invariant either holds or carries a
+    reasoned pragma."""
+    cfg = LintConfig(root=REPO)
+    findings = run_lint(cfg, ["handyrl_tpu/"])
+    assert findings == [], "\n" + "\n".join(f.format() for f in findings)
+
+
+def test_repo_baseline_is_empty_for_core_rules():
+    baseline = load_baseline(REPO / "tools" / "graftlint" / "baseline.json")
+    for rule in ("HS001", "DL002", "MP003"):
+        assert not baseline.get(rule), (
+            f"{rule} baseline must stay empty — fix or pragma-annotate "
+            "instead of grandfathering"
+        )
+
+
+def test_cli_exits_zero_on_clean_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "handyrl_tpu/", "--baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_exits_nonzero_on_findings(tmp_path):
+    # a violating file through the real CLI -> exit 1 + a formatted finding
+    tree = tmp_path / "repo"
+    (tree / "handyrl_tpu" / "runtime").mkdir(parents=True)
+    bad = tree / "handyrl_tpu" / "runtime" / "trainer.py"
+    bad.write_text(
+        "import jax\n\n\ndef loop(fn, state, batches):\n"
+        "    for b in batches:\n"
+        "        state, m = fn.train_step(state, b, 1e-3)\n"
+        "        jax.block_until_ready(m)\n"
+        "    return state\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "handyrl_tpu/",
+         "--root", str(tree), "--rules", "HS001", "--no-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "HS001" in proc.stdout and "trainer.py:7" in proc.stdout

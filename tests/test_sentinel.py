@@ -487,7 +487,13 @@ def test_nan_injection_skips_rolls_back_and_finishes(tmp_path, monkeypatch):
 
     monkeypatch.chdir(tmp_path)
     monkeypatch.setenv("HANDYRL_FAULT_NAN_AT_STEP", "5:1000000")
-    args = _device_replay_args(sentinel_rollback_after=2, epochs=4)
+    # epochs are EPISODE-counted and device generation floods the books,
+    # so a slow/loaded host fits only ~1 SGD step per epoch — with 4
+    # epochs the run could end at exactly step 5 (the fault onset) with
+    # every recorded epoch still clean, flaking the assertions below.
+    # 8 epochs guarantees the recorded run crosses the fault window with
+    # the SAME assertions (observed marginal on this container 2026-08-04)
+    args = _device_replay_args(sentinel_rollback_after=2, epochs=8)
     learner = Learner(args)
     assert learner.run() == 0
 

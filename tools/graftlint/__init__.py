@@ -1,0 +1,36 @@
+"""graftlint — repo-invariant static analyzer for handyrl_tpu.
+
+Six rules turn four PRs' worth of tribal review rules into a mechanical
+gate (catalog + rationale: docs/static_analysis.md):
+
+    HS001  no blocking host syncs in hot-loop modules
+    DL002  compiled-call dispatch sites wrapped in dispatch_serialized
+           with an explicit device scope
+    MP003  no lock-holding mp primitives in batcher-child code paths
+    RNG004 no jax PRNG key consumed twice without split
+    CFG005 config knobs <-> docs/parameters.md parity, both directions
+    MET006 metrics.jsonl writer/consumer key-registry parity
+
+Run: ``python -m tools.graftlint handyrl_tpu/ --baseline``
+Escape hatch: ``# graftlint: allow[RULE] reason=...``
+"""
+
+from .core import (
+    Finding,
+    LintConfig,
+    RULE_IDS,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "RULE_IDS",
+    "apply_baseline",
+    "load_baseline",
+    "run_lint",
+    "write_baseline",
+]

@@ -1,0 +1,110 @@
+"""CLI: ``python -m tools.graftlint [paths...] [options]``.
+
+Exit status: 0 = clean (after pragmas and, when present, the baseline),
+1 = findings, 2 = usage/internal error.  The default baseline
+(tools/graftlint/baseline.json) is applied automatically when it exists;
+``--no-baseline`` lints from zero, ``--write-baseline`` regenerates the
+file from the current findings (the grandfathering step — use it once,
+then burn the file down).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .core import (
+    RULE_IDS,
+    LintConfig,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = Path(__file__).parent / "baseline.json"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="repo-invariant static analyzer (rules: %s)" % ", ".join(RULE_IDS),
+    )
+    parser.add_argument("paths", nargs="*", default=["handyrl_tpu/"],
+                        help="files/directories to scan (default: handyrl_tpu/)")
+    parser.add_argument("--root", default=".", help="repo root (default: cwd)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule subset (default: all)")
+    parser.add_argument("--baseline", nargs="?", const=str(DEFAULT_BASELINE),
+                        default=None, metavar="PATH",
+                        help="apply a baseline file (default path when bare)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the default baseline even if it exists")
+    parser.add_argument("--write-baseline", nargs="?", const=str(DEFAULT_BASELINE),
+                        default=None, metavar="PATH",
+                        help="write current findings as the new baseline and exit 0")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    args = parser.parse_args(argv)
+
+    root = Path(args.root).resolve()
+    config = LintConfig(root=root)
+    rules = None
+    if args.rules:
+        rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+        unknown = set(rules) - set(RULE_IDS)
+        if unknown:
+            print(f"graftlint: unknown rules {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    try:
+        findings = run_lint(config, args.paths or ["handyrl_tpu/"], rules)
+    except RuntimeError as exc:
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline is not None:
+        path = Path(args.write_baseline)
+        write_baseline(path, findings)
+        print(f"graftlint: wrote baseline with {len(findings)} finding(s) to {path}")
+        return 0
+
+    baseline_path = None
+    if args.baseline is not None:
+        baseline_path = Path(args.baseline)
+    elif not args.no_baseline and DEFAULT_BASELINE.exists():
+        baseline_path = DEFAULT_BASELINE
+
+    suppressed, stale = [], {}
+    if baseline_path is not None and baseline_path.exists():
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, json.JSONDecodeError) as exc:
+            print(f"graftlint: bad baseline {baseline_path}: {exc}", file=sys.stderr)
+            return 2
+        findings, suppressed, stale = apply_baseline(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in findings],
+            "suppressed": [f.__dict__ for f in suppressed],
+            "stale_baseline": {k: sorted(v) for k, v in stale.items()},
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        if suppressed:
+            print(f"graftlint: {len(suppressed)} finding(s) suppressed by baseline "
+                  f"({baseline_path})")
+        for rule, fps in sorted(stale.items()):
+            print(f"graftlint: {len(fps)} stale {rule} baseline entr"
+                  f"{'y' if len(fps) == 1 else 'ies'} — shrink {baseline_path}")
+        if not findings:
+            print("graftlint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

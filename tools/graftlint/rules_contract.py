@@ -1,0 +1,374 @@
+"""Repo-contract rules: CFG005 (config <-> docs parity) and MET006
+(metrics key registry parity between writers and consumers).
+
+Both rules parse their target files with ``ast``/text only — the linter
+never imports the code it checks.
+
+CFG005: the knobs are the keys of ``DEFAULT_TRAIN_ARGS`` /
+``DEFAULT_WORKER_ARGS`` in config.py (nested sections like ``worker``
+flatten to dotted keys).  Every knob must have a ``docs/parameters.md``
+table row, and every documented train_args/worker_args row must name a
+real knob (aliases like ``attn_mode`` are declared in the config).
+
+MET006: ``handyrl_tpu/utils/metrics.py`` owns the metrics.jsonl key
+registry (``METRIC_KEYS`` + ``METRIC_KEY_PREFIXES``) — the tolerance
+contract between ``Learner._write_metrics`` writers and the
+``read_metrics`` consumers (plot scripts, ablate tools).  A writer
+emitting an unregistered key, or a consumer reading one, is a finding:
+new keys must be registered (which is what makes every consumer's
+``.get``-tolerance reviewable in one place).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, LintConfig, dotted
+
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+
+def run(config: LintConfig, enabled: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    if "CFG005" in enabled:
+        findings.extend(_cfg005(config))
+    if "MET006" in enabled:
+        findings.extend(_met006(config))
+    return findings
+
+
+# -- CFG005 -------------------------------------------------------------------
+
+
+def _dict_keys(node: ast.Dict, nested: Sequence[str], prefix: str = "",
+               out: Optional[Dict[str, int]] = None) -> Dict[str, int]:
+    if out is None:
+        out = {}
+    for key_node, value in zip(node.keys, node.values):
+        if not (isinstance(key_node, ast.Constant) and isinstance(key_node.value, str)):
+            continue
+        key = key_node.value
+        full = f"{prefix}{key}"
+        if isinstance(value, ast.Dict) and key in nested and not prefix:
+            _dict_keys(value, nested, prefix=f"{key}.", out=out)
+        else:
+            out[full] = key_node.lineno
+    return out
+
+
+def _default_knobs(path: Path, nested: Sequence[str]) -> Tuple[Dict[str, int], Dict[str, int]]:
+    tree = ast.parse(path.read_text(), filename=str(path))
+    train: Dict[str, int] = {}
+    worker: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        # both plain and annotated assignment (DEFAULT_TRAIN_ARGS:
+        # Dict[str, Any] = {...} is an ast.AnnAssign)
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+        elif (
+            isinstance(node, ast.AnnAssign)
+            and isinstance(node.value, ast.Dict)
+            and isinstance(node.target, ast.Name)
+        ):
+            targets = [node.target]
+        else:
+            continue
+        for target in targets:
+            if target.id == "DEFAULT_TRAIN_ARGS":
+                train = _dict_keys(node.value, nested)
+            elif target.id == "DEFAULT_WORKER_ARGS":
+                worker = _dict_keys(node.value, nested)
+    return train, worker
+
+
+def _doc_rows(path: Path) -> Dict[str, Dict[str, int]]:
+    """section name ('train_args'/'worker_args'/...) -> {key: lineno}."""
+    sections: Dict[str, Dict[str, int]] = {}
+    current: Optional[str] = None
+    for lineno, line in enumerate(path.read_text().splitlines(), start=1):
+        if line.startswith("## "):
+            current = line[3:].strip()
+            sections.setdefault(current, {})
+            continue
+        if current is None or not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1].strip() if line.count("|") >= 2 else ""
+        if not first_cell or set(first_cell) <= {"-", " ", ":"} or first_cell == "key":
+            continue
+        for token in _BACKTICK_RE.findall(first_cell):
+            token = token.strip()
+            if token:
+                sections[current].setdefault(token, lineno)
+    return sections
+
+
+def _cfg005(config: LintConfig) -> Iterable[Finding]:
+    cfg_path = config.root / config.cfg005_config
+    docs_path = config.root / config.cfg005_docs
+    if not cfg_path.exists() or not docs_path.exists():
+        yield Finding("CFG005", config.cfg005_config, 1,
+                      f"CFG005 targets missing: {cfg_path.name} or "
+                      f"{docs_path.name} not found")
+        return
+    train, worker = _default_knobs(cfg_path, config.cfg005_nested)
+    sections = _doc_rows(docs_path)
+    doc_train = sections.get("train_args", {})
+    doc_worker = sections.get("worker_args", {})
+    aliases = set(config.cfg005_doc_aliases)
+
+    for knob, lineno in sorted(train.items()):
+        if knob not in doc_train:
+            yield Finding("CFG005", config.cfg005_config, lineno,
+                          f"train_args knob '{knob}' has no docs/parameters.md "
+                          "row (document it, or delete the knob)")
+    for knob, lineno in sorted(worker.items()):
+        if knob not in doc_worker:
+            yield Finding("CFG005", config.cfg005_config, lineno,
+                          f"worker_args knob '{knob}' has no docs/parameters.md "
+                          "row (document it, or delete the knob)")
+    for key, lineno in sorted(doc_train.items()):
+        if key not in train and key not in aliases:
+            yield Finding("CFG005", config.cfg005_docs, lineno,
+                          f"documented train_args row '{key}' is not a "
+                          "validated knob in config.py (stale row, typo, or "
+                          "an undeclared alias)")
+    for key, lineno in sorted(doc_worker.items()):
+        if key not in worker and key not in aliases:
+            yield Finding("CFG005", config.cfg005_docs, lineno,
+                          f"documented worker_args row '{key}' is not a "
+                          "default in config.py (stale row or missing "
+                          "default)")
+
+
+# -- MET006 -------------------------------------------------------------------
+
+
+def _registry(path: Path) -> Tuple[Set[str], Tuple[str, ...], bool]:
+    """(exact keys, prefixes, found) from METRIC_KEYS / METRIC_KEY_PREFIXES."""
+    keys: Set[str] = set()
+    prefixes: Tuple[str, ...] = ()
+    found = False
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if not isinstance(target, ast.Name):
+                continue
+            if target.id == "METRIC_KEYS":
+                found = True
+                value = node.value
+                if isinstance(value, ast.Call):  # frozenset({...})
+                    value = value.args[0] if value.args else ast.Set(elts=[])
+                if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+                    keys = {
+                        e.value for e in value.elts
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    }
+            elif target.id == "METRIC_KEY_PREFIXES":
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    prefixes = tuple(
+                        e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    )
+    return keys, prefixes, found
+
+
+def _registered(key: str, keys: Set[str], prefixes: Tuple[str, ...]) -> bool:
+    return key in keys or any(key.startswith(p) for p in prefixes)
+
+
+def _writer_keys(path: Path, config: LintConfig) -> Dict[str, int]:
+    """Statically-visible metrics keys a writer module emits -> lineno."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    imports: Dict[str, str] = {}
+    out: Dict[str, int] = {}
+    record_names = set(config.met006_record_names)
+    stats_attrs = set(config.met006_stats_attrs)
+
+    def is_record_target(node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in record_names
+        if isinstance(node, ast.Attribute):
+            return dotted(node, imports) in stats_attrs
+        return False
+
+    for node in ast.walk(tree):
+        # record = {"k": ...} / record: Dict = {"k": ...} initializers
+        literal_targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            literal_targets = list(node.targets)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.value, ast.Dict):
+            literal_targets = [node.target]
+        for target in literal_targets:
+            if isinstance(target, ast.Name) and target.id in record_names:
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        out.setdefault(k.value, k.lineno)
+        # record["k"] = ... / self.stats["k"] = ...
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Subscript)
+                    and is_record_target(target.value)
+                ):
+                    sl = target.slice
+                    if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                        out.setdefault(sl.value, target.lineno)
+                    elif (
+                        isinstance(sl, ast.BinOp)
+                        and isinstance(sl.op, ast.Add)
+                        and isinstance(sl.left, ast.Constant)
+                        and isinstance(sl.left.value, str)
+                    ):
+                        # "pipe_" + key: the literal prefix is the contract
+                        out.setdefault(sl.left.value + "*", target.lineno)
+                # self.stats = {literal keys}
+                if (
+                    is_record_target(target)
+                    and isinstance(target, ast.Attribute)
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                            out.setdefault(k.value, k.lineno)
+        # record.update(k=...) / record.setdefault("k", ...)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "update" and is_record_target(node.func.value):
+                for kw in node.keywords:
+                    if kw.arg:
+                        out.setdefault(kw.arg, node.lineno)
+            if (
+                node.func.attr == "setdefault"
+                and is_record_target(node.func.value)
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                out.setdefault(node.args[0].value, node.lineno)
+        # module-level *_KEYS tuples feeding dynamic writes
+        if isinstance(node, ast.Assign) and isinstance(node.value, (ast.Tuple, ast.List)):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Name)
+                    and target.id in config.met006_key_tuples
+                ):
+                    prefix = config.met006_key_tuples[target.id]
+                    for e in node.value.elts:
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                            out.setdefault(prefix + e.value, e.lineno)
+    return out
+
+
+def _consumer_keys(path: Path, config: LintConfig) -> Dict[str, int]:
+    """Metrics keys a consumer file reads off record variables -> lineno."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    sources = set(config.met006_record_sources)
+    tracked_lists: Set[str] = set()
+    tracked: Set[str] = set(config.met006_record_names)
+
+    def source_call(node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else ""
+            )
+            return name in sources
+        return False
+
+    def tracked_iter(node: ast.AST) -> bool:
+        return source_call(node) or (
+            isinstance(node, ast.Name) and node.id in tracked_lists
+        )
+
+    # fixed point over one or two passes: lists from sources, elements
+    # from comprehensions/loops over those lists
+    for _ in range(3):
+        changed = False
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                name = node.targets[0].id
+                value = node.value
+                derived = source_call(value)
+                if isinstance(value, ast.ListComp):
+                    gen = value.generators[0]
+                    if tracked_iter(gen.iter):
+                        derived = True
+                if derived and name not in tracked_lists:
+                    tracked_lists.add(name)
+                    changed = True
+            if isinstance(node, (ast.comprehension,)):
+                if tracked_iter(node.iter) and isinstance(node.target, ast.Name):
+                    if node.target.id not in tracked:
+                        tracked.add(node.target.id)
+                        changed = True
+            if isinstance(node, ast.For) and tracked_iter(node.iter):
+                if isinstance(node.target, ast.Name) and node.target.id not in tracked:
+                    tracked.add(node.target.id)
+                    changed = True
+        if not changed:
+            break
+
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript) and isinstance(node.value, ast.Name):
+            if node.value.id in tracked:
+                sl = node.slice
+                if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                    out.setdefault(sl.value, node.lineno)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if (
+                node.func.attr in ("get", "setdefault")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in tracked
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                out.setdefault(node.args[0].value, node.lineno)
+    return out
+
+
+def _met006(config: LintConfig) -> Iterable[Finding]:
+    reg_path = config.root / config.met006_registry
+    if not reg_path.exists():
+        yield Finding("MET006", config.met006_registry, 1,
+                      "metrics key registry module not found")
+        return
+    keys, prefixes, found = _registry(reg_path)
+    if not found:
+        yield Finding("MET006", config.met006_registry, 1,
+                      "METRIC_KEYS registry missing — metrics.jsonl writers "
+                      "and consumers have no shared key contract")
+        return
+    for rel in config.met006_writers:
+        path = config.root / rel
+        if not path.exists():
+            continue
+        for key, lineno in sorted(_writer_keys(path, config).items()):
+            probe = key[:-1] if key.endswith("*") else key
+            ok = (
+                any(probe == p or probe.startswith(p) for p in prefixes)
+                if key.endswith("*")
+                else _registered(probe, keys, prefixes)
+            )
+            if not ok:
+                yield Finding("MET006", rel, lineno,
+                              f"metrics.jsonl key '{key}' written here is not "
+                              "in utils.metrics.METRIC_KEYS — register it so "
+                              "every reader's tolerance is reviewed")
+    for rel in config.met006_consumers:
+        path = config.root / rel
+        if not path.exists():
+            continue
+        for key, lineno in sorted(_consumer_keys(path, config).items()):
+            if not _registered(key, keys, prefixes):
+                yield Finding("MET006", rel, lineno,
+                              f"consumer reads metrics key '{key}' that is "
+                              "not in utils.metrics.METRIC_KEYS (stale key, "
+                              "typo, or an unregistered writer)")

@@ -1,0 +1,428 @@
+"""AST rules over runtime modules: HS001, DL002, MP003, RNG004.
+
+Each rule encodes an invariant earned by a prior PR (see
+docs/static_analysis.md for the catalog and the history):
+
+* HS001 — no blocking host syncs in the hot-loop modules (PR 6 removed
+  the last per-dispatch sync from the streaming path; one stray
+  ``block_until_ready`` reopens the 100x pipeline gap).
+* DL002 — every compiled-call dispatch site goes through
+  ``parallel.mesh.dispatch_serialized`` with an explicit device scope
+  (PR 3's per-device lock registry: concurrent multi-device programs
+  must reach every device in one order).
+* MP003 — batcher-child code paths touch no lock-holding multiprocessing
+  primitives (PR 2's SIGKILL-wedge classes: a child dies holding
+  whatever lock it was inside).
+* RNG004 — a jax PRNG key is never consumed twice without a split
+  (classic silent-correlation bug; straight-line analysis per block).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, LintConfig, Module, dotted, match_any
+
+_MP_PRIMITIVES = {
+    "Queue", "JoinableQueue", "SimpleQueue", "Event", "Lock", "RLock",
+    "Condition", "Semaphore", "BoundedSemaphore", "Barrier", "Manager",
+    "Pool",
+}
+_MP_BANNED_METHODS = {"is_set", "qsize", "join_thread"}
+
+
+def run(modules: Sequence[Module], config: LintConfig,
+        enabled: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    factories = _jit_factories(modules) if "DL002" in enabled else set()
+    for mod in modules:
+        if "HS001" in enabled and match_any(mod.rel, config.hs001_modules):
+            findings.extend(_hs001(mod, config))
+        if "DL002" in enabled and match_any(mod.rel, config.dl002_modules):
+            findings.extend(_dl002(mod, config, factories))
+        if "MP003" in enabled:
+            findings.extend(_mp003(mod))
+        if "RNG004" in enabled:
+            findings.extend(_rng004(mod))
+    return findings
+
+
+# -- HS001: blocking host syncs in hot-loop modules ---------------------------
+
+
+def _call_name(call: ast.Call, imports) -> Tuple[Optional[str], str]:
+    """(resolved dotted name or None, bare attribute/function name)."""
+    d = dotted(call.func, imports)
+    if isinstance(call.func, ast.Attribute):
+        return d, call.func.attr
+    if isinstance(call.func, ast.Name):
+        return d, call.func.id
+    return d, ""
+
+
+def _nearest_loop(mod: Module, node: ast.AST) -> Optional[ast.AST]:
+    for a in mod.ancestors(node):
+        if isinstance(a, (ast.For, ast.While)):
+            return a
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return None  # loop must be in the same function body
+    return None
+
+
+def _loop_dispatches(loop: ast.AST, mod: Module, hints: Sequence[str]) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            _, bare = _call_name(node, mod.imports)
+            if bare in hints:
+                return True
+    return False
+
+
+def _hs001(mod: Module, config: LintConfig) -> Iterable[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        funcs = mod.enclosing_funcs(node)
+        if any(f.name in config.hs001_allow_funcs for f in funcs):
+            continue
+        resolved, bare = _call_name(node, mod.imports)
+        # always-on primitives: these BLOCK the calling thread on device
+        # execution wherever they appear
+        if bare == "block_until_ready":
+            yield Finding("HS001", mod.rel, node.lineno,
+                          "blocking host sync: block_until_ready in a "
+                          "hot-loop module (use async dispatch; drain only "
+                          "in teardown paths)")
+            continue
+        if resolved == "jax.device_get" or (resolved or "").endswith(".device_get"):
+            yield Finding("HS001", mod.rel, node.lineno,
+                          "blocking host sync: jax.device_get in a hot-loop "
+                          "module (fetch at epoch boundaries, not per "
+                          "dispatch)")
+            continue
+        if bare == "item" and not node.args and not node.keywords and isinstance(
+            node.func, ast.Attribute
+        ):
+            yield Finding("HS001", mod.rel, node.lineno,
+                          "blocking host sync: .item() in a hot-loop module")
+            continue
+        # loop-scoped primitives: a host conversion is only a per-dispatch
+        # sync when its nearest enclosing loop is a dispatching loop
+        is_asarray = resolved in ("numpy.asarray", "numpy.array")
+        is_float = (
+            isinstance(node.func, ast.Name) and node.func.id == "float"
+            and node.args and not isinstance(node.args[0], ast.Constant)
+        )
+        if is_asarray or is_float:
+            loop = _nearest_loop(mod, node)
+            if loop is not None and _loop_dispatches(loop, mod, config.dispatch_hints):
+                what = "np.asarray" if is_asarray else "float()"
+                yield Finding("HS001", mod.rel, node.lineno,
+                              f"blocking host sync: {what} of a (possibly "
+                              "device-resident) value inside a dispatching "
+                              "hot loop")
+
+
+# -- DL002: dispatch sites must be wrapped + explicit -------------------------
+
+
+def _jit_factories(modules: Sequence[Module]) -> Set[str]:
+    """Names of functions (any scanned module) that RETURN a jax.jit
+    callable — assignments from their calls are jit-bound targets."""
+    out: Set[str] = set()
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for ret in ast.walk(node):
+                if (
+                    isinstance(ret, ast.Return)
+                    and isinstance(ret.value, ast.Call)
+                    and dotted(ret.value.func, mod.imports) == "jax.jit"
+                ):
+                    out.add(node.name)
+                    break
+    return out
+
+
+def _guard_nodes(mod: Module, wrapper: str) -> Set[ast.AST]:
+    """Function/lambda nodes whose body executes under the dispatch
+    wrapper's locks: literal lambdas/defs passed as its first argument."""
+    guards: Set[ast.AST] = set()
+    named: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Call)
+            and _call_name(node, mod.imports)[1] == wrapper
+            and node.args
+        ):
+            arg0 = node.args[0]
+            if isinstance(arg0, ast.Lambda):
+                guards.add(arg0)
+            elif isinstance(arg0, ast.Name):
+                named.add(arg0.id)
+    if named:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name in named:
+                guards.add(node)
+    return guards
+
+
+def _dl002(mod: Module, config: LintConfig,
+           factories: Set[str]) -> Iterable[Finding]:
+    wrapper = config.dispatch_wrapper
+    guards = _guard_nodes(mod, wrapper)
+
+    # jit-bound assignment targets (dotted reprs) in this module
+    jit_targets: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = dotted(node.value.func, mod.imports)
+            bare = _call_name(node.value, mod.imports)[1]
+            if callee == "jax.jit" or bare in factories:
+                for target in node.targets:
+                    rep = dotted(target, mod.imports)
+                    if rep:
+                        jit_targets.add(rep)
+
+    def under_guard(node: ast.AST) -> bool:
+        return any(a in guards for a in mod.ancestors(node))
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        bare = _call_name(node, mod.imports)[1]
+        # check the wrapper's own call sites for an explicit device scope
+        if bare == wrapper:
+            in_def = any(
+                isinstance(a, ast.FunctionDef) and a.name == wrapper
+                for a in mod.ancestors(node)
+            )
+            if in_def:
+                continue
+            devices_given = len(node.args) >= 2 or any(
+                kw.arg == "devices" for kw in node.keywords
+            )
+            explicit_none = (
+                len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and node.args[1].value is None
+            )
+            if not devices_given or explicit_none:
+                yield Finding("DL002", mod.rel, node.lineno,
+                              f"{wrapper} without an explicit device scope "
+                              "(pass the mesh/devices the program touches; "
+                              "None serializes with everything)")
+            continue
+        # direct invocation of a jit-bound callable outside the locks
+        rep = dotted(node.func, mod.imports)
+        if rep in jit_targets and not under_guard(node):
+            yield Finding("DL002", mod.rel, node.lineno,
+                          f"compiled call {rep}(...) dispatched outside "
+                          f"{wrapper} — concurrent multi-device programs "
+                          "need one per-device program order")
+            continue
+        # immediate jax.jit(...)(args) invocation
+        if (
+            isinstance(node.func, ast.Call)
+            and dotted(node.func.func, mod.imports) == "jax.jit"
+            and not under_guard(node)
+        ):
+            yield Finding("DL002", mod.rel, node.lineno,
+                          f"jax.jit(...)(...) dispatched outside {wrapper}")
+
+
+# -- MP003: mp primitives in batcher-child code paths -------------------------
+
+
+def _mp003(mod: Module) -> Iterable[Finding]:
+    # child roots: functions passed as target= to a *.Process(...) call
+    roots: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _call_name(node, mod.imports)[1] == "Process":
+            for kw in node.keywords:
+                if kw.arg == "target" and isinstance(kw.value, ast.Name):
+                    roots.add(kw.value.id)
+    if not roots:
+        return
+    # same-module call-graph closure from the roots
+    defs: Dict[str, ast.AST] = {
+        n.name: n
+        for n in ast.walk(mod.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    closure: Set[str] = set()
+    frontier = [r for r in roots if r in defs]
+    while frontier:
+        name = frontier.pop()
+        if name in closure:
+            continue
+        closure.add(name)
+        for node in ast.walk(defs[name]):
+            if isinstance(node, ast.Call):
+                bare = _call_name(node, mod.imports)[1]
+                if bare in defs and bare not in closure:
+                    frontier.append(bare)
+    for name in closure:
+        for node in ast.walk(defs[name]):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved, bare = _call_name(node, mod.imports)
+            if bare in _MP_PRIMITIVES and resolved and (
+                resolved.startswith("multiprocessing")
+                or resolved.split(".")[0] in ("mp", "multiprocessing")
+                or ".multiprocessing." in f".{resolved}."
+            ):
+                yield Finding("MP003", mod.rel, node.lineno,
+                              f"mp.{bare} constructed in batcher-child code "
+                              f"path {name}() — a SIGKILL'd child dies "
+                              "holding mp locks; use raw pipes / lock-free "
+                              "Values (PR 2 wedge classes)")
+            elif bare in _MP_BANNED_METHODS and isinstance(node.func, ast.Attribute):
+                yield Finding("MP003", mod.rel, node.lineno,
+                              f".{bare}() in batcher-child code path "
+                              f"{name}() — lock-holding mp accessor in a "
+                              "child hot loop (mp.Event.is_set takes the "
+                              "shared cond lock; qsize the queue lock)")
+
+
+# -- RNG004: PRNG key consumed twice without split ----------------------------
+
+
+class _KeyState:
+    __slots__ = ("uses",)
+
+    def __init__(self) -> None:
+        self.uses: Dict[str, int] = {}
+
+    def copy(self) -> "_KeyState":
+        s = _KeyState()
+        s.uses = dict(self.uses)
+        return s
+
+    def merge_max(self, other: "_KeyState") -> None:
+        for k, v in other.uses.items():
+            self.uses[k] = max(self.uses.get(k, 0), v)
+
+
+_KEY_SOURCES = ("jax.random.PRNGKey", "jax.random.split", "jax.random.fold_in",
+                "jax.random.key")
+
+
+def _terminates(body: Sequence[ast.stmt]) -> bool:
+    """True when the block cannot fall through to the statement after it."""
+    if not body:
+        return False  # an absent else DOES fall through
+    return isinstance(body[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _rng004(mod: Module) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            state = _KeyState()
+            _rng_walk_block(node.body, state, mod, findings)
+    return findings
+
+
+def _is_key_source(call: ast.Call, mod: Module) -> bool:
+    d = dotted(call.func, mod.imports)
+    if d in _KEY_SOURCES:
+        return True
+    # tolerate `from jax import random` / `import jax.random as jrandom`
+    return bool(d and d.split(".")[-1] in ("PRNGKey", "split", "fold_in")
+                and "random" in d)
+
+
+def _consume_names(node: ast.AST, state: _KeyState, mod: Module,
+                   findings: List[Finding]) -> None:
+    """Count key names passed as call arguments anywhere under ``node``
+    (nested lambdas/defs count once — they capture, and usually run once)."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in state.uses:
+                state.uses[arg.id] += 1
+                if state.uses[arg.id] == 2:
+                    findings.append(Finding(
+                        "RNG004", mod.rel, arg.lineno,
+                        f"PRNG key '{arg.id}' consumed twice without "
+                        "jax.random.split — reusing a key correlates "
+                        "streams silently",
+                    ))
+
+
+def _rng_walk_block(body: Sequence[ast.stmt], state: _KeyState, mod: Module,
+                    findings: List[Finding]) -> None:
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            inner = _KeyState()
+            inner_body = stmt.body
+            _rng_walk_block(inner_body, inner, mod, findings)
+            continue
+        if isinstance(stmt, ast.Assign):
+            # RHS consumption first, then LHS rebinding
+            _consume_names(stmt.value, state, mod, findings)
+            is_source = isinstance(stmt.value, ast.Call) and _is_key_source(
+                stmt.value, mod
+            )
+            for target in stmt.targets:
+                names = (
+                    [target] if isinstance(target, ast.Name)
+                    else list(target.elts) if isinstance(target, (ast.Tuple, ast.List))
+                    else []
+                )
+                for t in names:
+                    if isinstance(t, ast.Name):
+                        if is_source:
+                            state.uses[t.id] = 0       # fresh key binding
+                        elif t.id in state.uses:
+                            del state.uses[t.id]        # rebound to non-key
+            continue
+        if isinstance(stmt, ast.If):
+            _consume_names(stmt.test, state, mod, findings)
+            body_state = state.copy()
+            else_state = state.copy()
+            _rng_walk_block(stmt.body, body_state, mod, findings)
+            _rng_walk_block(stmt.orelse, else_state, mod, findings)
+            # only one branch runs: merged use count is the max, not sum —
+            # and a branch that cannot fall through (return/raise/...)
+            # contributes nothing to the code after the If
+            state.uses = {}
+            if not _terminates(stmt.body):
+                state.merge_max(body_state)
+            if not _terminates(stmt.orelse):
+                state.merge_max(else_state)
+            continue
+        if isinstance(stmt, (ast.For, ast.While)):
+            # single-pass body analysis: catches double use WITHIN one
+            # iteration; cross-iteration reuse (no reassignment before the
+            # loop repeats) is out of scope to avoid false positives on
+            # guarded/continue-heavy loops
+            loop_state = state.copy()
+            if isinstance(stmt, ast.For):
+                _consume_names(stmt.iter, loop_state, mod, findings)
+            else:
+                _consume_names(stmt.test, loop_state, mod, findings)
+            _rng_walk_block(stmt.body, loop_state, mod, findings)
+            _rng_walk_block(stmt.orelse, loop_state, mod, findings)
+            state.merge_max(loop_state)
+            continue
+        if isinstance(stmt, (ast.Try,)):
+            inner = state.copy()
+            _rng_walk_block(stmt.body, inner, mod, findings)
+            for handler in stmt.handlers:
+                _rng_walk_block(handler.body, inner.copy(), mod, findings)
+            _rng_walk_block(stmt.orelse, inner, mod, findings)
+            _rng_walk_block(stmt.finalbody, inner, mod, findings)
+            state.merge_max(inner)
+            continue
+        if isinstance(stmt, (ast.With,)):
+            for item in stmt.items:
+                _consume_names(item.context_expr, state, mod, findings)
+            _rng_walk_block(stmt.body, state, mod, findings)
+            continue
+        # plain statement (Expr, Return, Aug, ...): count consumptions
+        _consume_names(stmt, state, mod, findings)
