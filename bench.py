@@ -1593,11 +1593,212 @@ TRANSFORMER_TPU_OVERRIDES = {"batch_size": 64, "burn_in_steps": 2,
                              # program
                              "seq_attention": "einsum"}
 
+# ---------------------------------------------------------------------------
+# serving: the standalone inference serving plane under load (ROADMAP item 2)
+# ---------------------------------------------------------------------------
+
+# load-generator geometry (per phase; durations scale with T_TRAIN/QUICK)
+SERVING_CLIENTS = 4 if QUICK else 8        # closed-loop connections
+SERVING_WINDOW = 8                          # outstanding requests per conn
+SERVING_SHED_SLO_MS = 25.0                  # tight budget for the shed legs
+
+
+def _serving_bench(duration: float):
+    """Latency-SLO bench of the serving plane (handyrl_tpu/serving) over
+    the REAL framed-socket transport: closed-loop saturation QPS with
+    client-measured p50/p99, shed rate at two offered loads against a
+    tight SLO (shed-fast must engage under overload and stay quiet under
+    it), and a hot-swap leg measuring time-to-first-response on the new
+    model with a zero-drop count — the zero-downtime contract measured,
+    not asserted."""
+    import threading as _threading
+
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.models import init_variables
+    from handyrl_tpu.serving import (
+        ModelRouter, ServingClient, ServingError, ServingServer,
+    )
+    from handyrl_tpu.serving.batcher import percentiles_ms
+
+    env = make_env({"env": "TicTacToe"})
+    module = env.net()
+    env.reset()
+    obs = env.observation(0)
+    p1 = init_variables(module, env, seed=1)["params"]
+    p2 = init_variables(module, env, seed=2)["params"]
+
+    base_cfg = {
+        "port": 0, "max_models": 4, "slo_ms": 1000.0, "shed_policy": "none",
+        "max_batch": 64, "max_wait_ms": 1.0,
+        # every power-of-two bucket pre-warmed: real traffic reaches them
+        # all, and a hot-path compile would both spike p99 and (pre-warm)
+        # distort the admission EMA's first samples
+        "warm_buckets": [1, 2, 4, 8, 16, 32, 64],
+        "queue_bound": 8192, "recv_timeout": 0.0, "watch_interval": 0.0,
+        "stats_interval": 0.0,
+    }
+
+    def start_server(**overrides):
+        cfg = dict(base_cfg, **overrides)
+        router = ModelRouter(module, obs, cfg, model_dir=".")
+        router.publish(1, p1)
+        return router, ServingServer(router, cfg).run()
+
+    def closed_loop(port, dur, lat, counts, models=None, stop=None):
+        """One connection keeping SERVING_WINDOW requests outstanding."""
+        client = ServingClient("127.0.0.1", port)
+        inflight = []
+        end = time.perf_counter() + dur
+        try:
+            while time.perf_counter() < end and not (stop and stop.is_set()):
+                while len(inflight) < SERVING_WINDOW:
+                    inflight.append((time.perf_counter(), client.submit(obs)))
+                t0, fut = inflight.pop(0)
+                try:
+                    reply = fut.result(timeout=120)
+                    lat.append((time.perf_counter() - t0) * 1000.0)
+                    counts["ok"] += 1
+                    if models is not None:
+                        models.append((time.perf_counter(), reply["model"]))
+                except Exception:
+                    counts["err"] += 1
+            for _t0, fut in inflight:
+                try:
+                    fut.result(timeout=120)
+                    counts["ok"] += 1
+                except Exception:
+                    counts["err"] += 1
+        finally:
+            client.close()
+
+    out = {"clients": SERVING_CLIENTS, "window": SERVING_WINDOW}
+
+    # -- phase 1: closed-loop saturation + latency percentiles ------------
+    router, server = start_server()
+    lats = [[] for _ in range(SERVING_CLIENTS)]
+    counts = [dict(ok=0, err=0) for _ in range(SERVING_CLIENTS)]
+    threads = [
+        _threading.Thread(target=closed_loop,
+                          args=(server.bound_port, duration, lats[i], counts[i]),
+                          daemon=True)
+        for i in range(SERVING_CLIENTS)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    total_ok = sum(c["ok"] for c in counts)
+    all_lat = [x for l in lats for x in l]
+    pct = percentiles_ms(all_lat)
+    out["saturation_qps"] = total_ok / max(elapsed, 1e-6)
+    out["p50_ms"] = pct[50]
+    out["p99_ms"] = pct[99]
+    out["requests"] = total_ok
+    out["load_errors"] = sum(c["err"] for c in counts)
+
+    # -- phase 3 (same server, still warm): hot-swap under load -----------
+    stop = _threading.Event()
+    swap_models = [[] for _ in range(max(2, SERVING_CLIENTS // 2))]
+    swap_counts = [dict(ok=0, err=0) for _ in swap_models]
+    threads = [
+        _threading.Thread(target=closed_loop,
+                          args=(server.bound_port, 120.0, [], swap_counts[i],
+                                swap_models[i], stop),
+                          daemon=True)
+        for i in range(len(swap_models))
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(min(1.0, duration / 4))
+    admin = ServingClient("127.0.0.1", server.bound_port)
+    t_swap = time.perf_counter()
+    swap = admin.swap(2, params=p2)
+    time.sleep(min(1.0, duration / 4))
+    stop.set()
+    for t in threads:
+        t.join(60)
+    admin.close()
+    events = sorted(e for l in swap_models for e in l)
+    new_times = [t for t, m in events if m == 2]
+    seen = {m for _, m in events}
+    out["swap_warm_ms"] = swap["warm_ms"]
+    out["swap_ttfr_ms"] = (
+        (new_times[0] - t_swap) * 1000.0 if new_times else None
+    )
+    out["swap_dropped"] = sum(c["err"] for c in swap_counts)
+    out["swap_flip_observed"] = seen == {1, 2}
+    server.shutdown()
+
+    # -- phase 2: shed rate vs offered load (fresh server, tight SLO) -----
+    def open_loop(port, rate, dur, counters):
+        """Paced open-loop offered load over several connections (one
+        socket serializing the whole rate would throttle the offer);
+        callbacks sort the outcomes."""
+        clients = [
+            ServingClient("127.0.0.1", port)
+            for _ in range(max(2, SERVING_CLIENTS // 2))
+        ]
+        lock = _threading.Lock()
+        pending = [0]
+
+        def cb(fut):
+            try:
+                fut.result()
+                kind = "ok"
+            except ServingError as exc:
+                kind = "shed" if exc.kind in ("shed", "deadline") else "err"
+            except Exception:
+                kind = "err"
+            with lock:
+                counters[kind] = counters.get(kind, 0) + 1
+                pending[0] -= 1
+
+        start = time.perf_counter()
+        sent = 0
+        try:
+            while time.perf_counter() - start < dur:
+                due = int((time.perf_counter() - start) * rate) - sent
+                for _ in range(min(max(due, 0), 512)):
+                    with lock:
+                        pending[0] += 1
+                    clients[sent % len(clients)].submit(
+                        obs, slo_ms=SERVING_SHED_SLO_MS
+                    ).add_done_callback(cb)
+                    sent += 1
+                time.sleep(0.002)
+            counters["offered"] = sent
+            deadline = time.perf_counter() + 60.0
+            while time.perf_counter() < deadline:
+                with lock:
+                    if pending[0] == 0:
+                        break
+                time.sleep(0.005)
+        finally:
+            for client in clients:
+                client.close()
+
+    sat = max(out["saturation_qps"], 1.0)
+    router, server = start_server(shed_policy="deadline",
+                                  slo_ms=SERVING_SHED_SLO_MS)
+    for tag, rate in (("low", 0.25 * sat), ("high", 2.0 * sat)):
+        counters: dict = {}
+        open_loop(server.bound_port, rate, duration / 2, counters)
+        offered = max(counters.get("offered", 0), 1)
+        shed = counters.get("shed", 0)
+        out[f"offered_{tag}_qps"] = counters.get("offered", 0) / (duration / 2)
+        out[f"shed_rate_{tag}"] = shed / offered
+        out[f"errors_{tag}"] = counters.get("err", 0)
+    server.shutdown()
+    return out
+
+
 KNOWN_STAGES = (
     "tictactoe", "device-selfplay", "geese-device-selfplay", "geese-gen",
     "geese-train", "northstar", "northstar2", "northstar3", "northstar4",
     "geese-bf16", "geister", "geister-device-selfplay", "geister-devreplay",
-    "transformer", "transformer_long", "flash",
+    "serving", "transformer", "transformer_long", "flash",
 )
 # stages that consume another stage's result (main() gates them on it)
 STAGE_DEPS = {
@@ -2075,6 +2276,40 @@ def main() -> None:
             result["extra"]["geister_device_selfplay_episodes_note"] = gsd["episodes_note"]
 
     _run_stage(result, "geister-device-selfplay", stage_geister_device_selfplay)
+
+    # 4b2. the standalone serving plane under client load (ROADMAP item 2):
+    # saturation QPS + p50/p99 over the real socket transport, shed rate at
+    # two offered loads against a tight SLO, hot-swap TTFR + zero-drop count
+    def stage_serving():
+        sv = _serving_bench(T_TRAIN)
+        result["extra"]["serving_saturation_qps"] = _sig(sv["saturation_qps"])
+        result["extra"]["serving_p50_ms"] = _sig(sv["p50_ms"])
+        result["extra"]["serving_p99_ms"] = _sig(sv["p99_ms"])
+        result["extra"]["serving_requests"] = sv["requests"]
+        result["extra"]["serving_clients"] = sv["clients"]
+        result["extra"]["serving_swap_warm_ms"] = _sig(sv["swap_warm_ms"])
+        if sv["swap_ttfr_ms"] is not None:
+            result["extra"]["serving_swap_ttfr_ms"] = _sig(sv["swap_ttfr_ms"])
+        result["extra"]["serving_swap_dropped"] = sv["swap_dropped"]
+        result["extra"]["serving_swap_flip_observed"] = sv["swap_flip_observed"]
+        for tag in ("low", "high"):
+            result["extra"][f"serving_offered_{tag}_qps"] = _sig(
+                sv[f"offered_{tag}_qps"]
+            )
+            result["extra"][f"serving_shed_rate_{tag}"] = round(
+                sv[f"shed_rate_{tag}"], 4
+            )
+        if sv["load_errors"] or sv["errors_low"] or sv["errors_high"]:
+            result["error"] = (result["error"] or "") + (
+                f" serving: {sv['load_errors']}+{sv['errors_low']}"
+                f"+{sv['errors_high']} non-shed request failures"
+            )
+        if sv["swap_dropped"]:
+            result["error"] = (result["error"] or "") + (
+                f" serving: hot-swap dropped {sv['swap_dropped']} requests"
+            )
+
+    _run_stage(result, "serving", stage_serving)
 
     # 4c. turn-mode device-resident replay: Geister DRC trained straight
     # from device rings (all-player burn-in windows, runtime/device_replay

@@ -49,6 +49,30 @@ def sample_logits(
     return int(np.argmax(logits / np.float32(temperature) + gumbel))
 
 
+def mean_pool_outputs(member_outs: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+    """Mean-pool every non-hidden output across ensemble members
+    (reference EnsembleAgent semantics, agent.py:92-107).  Shared by the
+    acting ensemble here and the serving plane's ensemble routes — one
+    definition of 'ensemble output', so they cannot silently diverge."""
+    keys = {
+        k
+        for out in member_outs
+        for k, v in out.items()
+        if k != "hidden" and v is not None
+    }
+    return {
+        k: np.mean(
+            [
+                np.asarray(out[k], np.float32)
+                for out in member_outs
+                if out.get(k) is not None
+            ],
+            axis=0,
+        )
+        for k in keys
+    }
+
+
 def _scalar(x) -> Optional[float]:
     return None if x is None else float(np.asarray(x).reshape(-1)[0])
 
@@ -132,23 +156,7 @@ class Agent:
             out = m.inference(obs, self._hidden[i])
             self._hidden[i] = out.get("hidden")
             member_outs.append(out)
-        keys = {
-            k
-            for out in member_outs
-            for k, v in out.items()
-            if k != "hidden" and v is not None
-        }
-        return {
-            k: np.mean(
-                [
-                    np.asarray(out[k], np.float32)
-                    for out in member_outs
-                    if out.get(k) is not None
-                ],
-                axis=0,
-            )
-            for k in keys
-        }
+        return mean_pool_outputs(member_outs)
 
     def action(self, env, player: int, show: bool = False) -> int:
         outputs = self._forward(env.observation(player))

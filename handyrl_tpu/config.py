@@ -175,6 +175,44 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     "device_replay_slots": 1024,
     # game steps advanced per rollout dispatch in the device_replay loop
     "device_replay_k_steps": 32,
+    # --- inference serving plane (docs/serving.md) ----------------------
+    # `main.py --serve` (or ServingServer embedded): continuous-batching
+    # inference over the framed-socket transport, multi-model routing and
+    # zero-downtime hot-swap on new verified checkpoints
+    "serving": {
+        # TCP port the serving front listens on (0 = ephemeral, for tests)
+        "port": 9997,
+        # resident snapshot engines beyond which the LRU non-latest engine
+        # is retired (drained, never dropped); the latest is always pinned
+        "max_models": 4,
+        # default per-request latency budget: a request with no explicit
+        # slo_ms must complete within this or be shed/expired (not imposed
+        # under shed_policy: none)
+        "slo_ms": 200.0,
+        # 'deadline' sheds on predicted SLO violation (queue waves x EMA
+        # batch time), 'queue' sheds only at queue_bound, 'none' never
+        # sheds and imposes no default deadline (every admitted request
+        # completes — drain semantics; explicit request slo_ms still holds)
+        "shed_policy": "deadline",
+        # power-of-two bucket cap per device batch (engine max_batch)
+        "max_batch": 64,
+        # straggler wait once the first request of a batch arrived
+        "max_wait_ms": 2.0,
+        # bucket sizes compiled at engine build / before a hot-swap flip;
+        # the first post-swap request must never pay an XLA compile
+        "warm_buckets": [1, 8],
+        # queued-request bound per engine (both shed policies enforce it)
+        "queue_bound": 1024,
+        # silent-client reaping deadline on the server hub (0 = keep
+        # idle connections forever; request/reply clients may be bursty)
+        "recv_timeout": 0.0,
+        # seconds between checkpoint-manifest polls for auto hot-swap on
+        # a new verified snapshot (0 = swap only on explicit request)
+        "watch_interval": 0.0,
+        # seconds between serve_* health records appended to metrics_path
+        # by the standalone server (0 = off)
+        "stats_interval": 30.0,
+    },
     "metrics_path": "metrics.jsonl",
     "model_dir": "models",
     "battle_port": 9876,
@@ -403,6 +441,48 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
     # (Geister) record observer views; turn-player-only envs must refuse
     if not 0.0 <= train["eval_rate"] <= 1.0:
         raise ValueError("train_args.eval_rate must be in [0, 1]")
+    serving = train["serving"]
+    if serving["shed_policy"] not in ("deadline", "queue", "none"):
+        raise ValueError(
+            f"train_args.serving.shed_policy={serving['shed_policy']!r} "
+            "not one of ('deadline', 'queue', 'none')"
+        )
+    if int(serving["max_models"]) < 1:
+        raise ValueError("train_args.serving.max_models must be >= 1")
+    if float(serving["slo_ms"]) <= 0:
+        raise ValueError("train_args.serving.slo_ms must be > 0")
+    if int(serving["max_batch"]) < 1:
+        raise ValueError("train_args.serving.max_batch must be >= 1")
+    if float(serving["max_wait_ms"]) < 0:
+        raise ValueError("train_args.serving.max_wait_ms must be >= 0")
+    if int(serving["queue_bound"]) < 1:
+        raise ValueError("train_args.serving.queue_bound must be >= 1")
+    buckets = serving["warm_buckets"]
+    if not isinstance(buckets, (list, tuple)) or not buckets:
+        raise ValueError(
+            "train_args.serving.warm_buckets must be a non-empty list of "
+            "bucket sizes"
+        )
+    for b in buckets:
+        if not isinstance(b, int) or b < 1 or (b & (b - 1)):
+            raise ValueError(
+                f"train_args.serving.warm_buckets entries must be powers of "
+                f"two >= 1 (the engine's compiled batch shapes), got {b!r}"
+            )
+        if b > int(serving["max_batch"]):
+            raise ValueError(
+                f"train_args.serving.warm_buckets entry {b} exceeds "
+                f"serving.max_batch {serving['max_batch']} — it would warm a "
+                "shape the engine never dispatches"
+            )
+    for key in ("recv_timeout", "watch_interval", "stats_interval"):
+        if float(serving[key]) < 0:
+            raise ValueError(f"train_args.serving.{key} must be >= 0 (0 = off)")
+    if not isinstance(serving["port"], int) or not 0 <= serving["port"] <= 65535:
+        raise ValueError(
+            f"train_args.serving.port={serving['port']!r} must be a TCP port "
+            "(0 = ephemeral)"
+        )
     if train["seq_attention"] not in ("auto", "flash", "einsum", "ring"):
         raise ValueError(
             f"train_args.seq_attention={train['seq_attention']!r} "

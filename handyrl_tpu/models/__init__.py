@@ -1,6 +1,6 @@
 from .nets import SimpleConvNet, GeeseNet, GeisterNet
 from .transformer import TransformerNet
-from .inference import InferenceModel, RandomModel, init_variables
+from .inference import InferenceModel, RandomModel, fetch_outputs, init_variables
 from .export import ExportedModel, OnnxModel, export_model, export_onnx
 
 __all__ = [
@@ -10,6 +10,7 @@ __all__ = [
     "TransformerNet",
     "InferenceModel",
     "RandomModel",
+    "fetch_outputs",
     "init_variables",
     "ExportedModel",
     "OnnxModel",

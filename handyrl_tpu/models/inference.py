@@ -27,6 +27,18 @@ def jitted_apply(module):
     return jax.jit(module.apply)
 
 
+def fetch_outputs(outputs) -> Dict[str, Any]:
+    """Bring async device outputs to the host as a numpy pytree.
+
+    The explicit fetch half of the serving plane's dispatch/fetch split:
+    ``inference_batch_async`` enqueues the program (called under the
+    per-device dispatch locks), and THIS runs outside them, so the locks
+    cover only the enqueue — a second model's engine on the same device
+    can dispatch while the first batch's outputs stream back.
+    """
+    return tree_map(np.asarray, jax.device_get(outputs))
+
+
 def init_variables(module, env, seed: int = 0):
     """Initialize model variables from a sample observation of ``env``."""
     env.reset()
@@ -66,6 +78,13 @@ class InferenceModel(SingleInferenceMixin):
     def init_hidden(self, batch_dims=()):
         hidden = self.module.initial_state(tuple(batch_dims))
         return None if hidden is None else tree_map(np.asarray, hidden)
+
+    def inference_batch_async(self, obs, hidden=None):
+        """Enqueue one batched apply and return the ASYNC device outputs
+        (no host sync).  Callers that need numpy pass the result through
+        ``fetch_outputs`` — the serving plane dispatches this under
+        ``dispatch_serialized`` and fetches outside the device locks."""
+        return self._apply(self.variables, obs, hidden)
 
     def inference_batch(self, obs, hidden=None) -> Dict[str, Any]:
         outputs = self._apply(self.variables, obs, hidden)

@@ -32,11 +32,35 @@ class EngineStopped(RuntimeError):
     """Raised to waiters when the engine is stopped with requests pending."""
 
 
-def _next_bucket(n: int, max_batch: int) -> int:
+def next_bucket(n: int, max_batch: int) -> int:
+    """Smallest power-of-two >= n, capped at max_batch — the static batch
+    shapes XLA compiles (shared with the serving plane's batcher)."""
     b = 1
     while b < n:
         b *= 2
     return min(b, max_batch)
+
+
+_next_bucket = next_bucket  # pre-serving-plane spelling
+
+
+def stack_padded(obs_list, hid_list, bucket: int, hidden_template):
+    """Pad to ``bucket`` rows and stack into one batch (shared by this
+    engine and the serving batcher — the padding semantics are subtle and
+    must not drift: pad rows REPLICATE real entries, because they must be
+    valid observations/state or XLA's output for the live rows changes).
+    ``hid_list`` entries of None take the module's initial-state template;
+    a None ``hidden_template`` means a stateless model (no hidden batch).
+    """
+    obs_list = list(obs_list)
+    obs_list += [obs_list[0]] * (bucket - len(obs_list))
+    obs_batch = tree_stack(obs_list)
+    hidden_batch = None
+    if hidden_template is not None:
+        hid_list = [h if h is not None else hidden_template for h in hid_list]
+        hid_list += [hidden_template] * (bucket - len(hid_list))
+        hidden_batch = tree_stack(hid_list)
+    return obs_batch, hidden_batch
 
 
 class BatchedInferenceClient:
@@ -67,6 +91,10 @@ class BatchedInferenceEngine:
         self._queue: queue.Queue = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # orders submit vs stop: an item can only be enqueued while the
+        # stop flag is provably unset, so exactly one party ever owns the
+        # final drain (the serve thread when it exists, stop() otherwise)
+        self._lifecycle = threading.Lock()
         self.batches_served = 0
         self.requests_served = 0
 
@@ -79,9 +107,21 @@ class BatchedInferenceEngine:
         return self
 
     def stop(self) -> None:
-        self._stop.set()
-        self._queue.put(None)
-        # fail any requests that raced past the serve loop's exit
+        with self._lifecycle:
+            if self._stop.is_set():
+                return  # idempotent; the first stop already arranged the drain
+            self._stop.set()
+            self._queue.put(None)  # wake the dispatcher
+            thread = self._thread
+        if thread is None:
+            # never started: there is no serve thread to own the drain
+            self._fail_pending()
+
+    def _fail_pending(self) -> None:
+        """Fail every queued request.  Called exactly once, by the drain
+        owner: the serve loop after it observes stop (requests admitted
+        before the flag flipped are drained there), or stop() itself when
+        the engine never started."""
         while True:
             try:
                 item = self._queue.get_nowait()
@@ -104,12 +144,16 @@ class BatchedInferenceEngine:
 
     def submit(self, obs, hidden=None) -> Future:
         fut: Future = Future()
-        if self._stop.is_set():
-            fut.set_exception(EngineStopped("inference engine stopped"))
-            return fut
-        self._queue.put((obs, hidden, fut))
-        if self._stop.is_set():  # raced with stop(): don't strand the waiter
-            self.stop()
+        with self._lifecycle:
+            # check-and-enqueue is atomic against stop(): after stop flips
+            # the flag (under this lock) no request can enter the queue, so
+            # the drain owner's final sweep provably sees every waiter —
+            # the old post-put "if stopped: re-drain" dance raced a second
+            # submit into a queue nobody would ever drain again
+            if self._stop.is_set():
+                fut.set_exception(EngineStopped("inference engine stopped"))
+                return fut
+            self._queue.put((obs, hidden, fut))
         return fut
 
     # -- dispatcher ---------------------------------------------------------
@@ -146,23 +190,19 @@ class BatchedInferenceEngine:
                 for _, _, fut in requests:
                     if not fut.done():
                         fut.set_exception(exc)
+        # single-owner drain: requests enqueued before stop flipped the
+        # flag (submit holds the lifecycle lock, so none land after) are
+        # failed here, on the one thread that also consumed them live
+        self._fail_pending()
 
     def _serve(self, requests: List) -> None:
         model = self.model
         n = len(requests)
-        bucket = _next_bucket(n, self.max_batch)
-
-        obs_list = [r[0] for r in requests]
-        obs_list += [obs_list[0]] * (bucket - n)
-        obs_batch = tree_stack(obs_list)
-
-        hidden_batch = None
-        template = model.init_hidden()
-        if template is not None:
-            hid_list = [r[1] if r[1] is not None else template for r in requests]
-            hid_list += [template] * (bucket - n)
-            hidden_batch = tree_stack(hid_list)
-
+        bucket = next_bucket(n, self.max_batch)
+        obs_batch, hidden_batch = stack_padded(
+            [r[0] for r in requests], [r[1] for r in requests],
+            bucket, model.init_hidden(),
+        )
         outputs = model.inference_batch(obs_batch, hidden_batch)
         outputs = tree_map(np.asarray, outputs)
         for i, (_, _, fut) in enumerate(requests):

@@ -515,6 +515,12 @@ class Learner:
             record["device_mean_episode_len"] = self._device_epoch_steps / self._device_epoch_eps
             self._device_epoch_eps = 0
             self._device_epoch_steps = 0
+        substituted = getattr(self.model_server, "substituted_snapshots", 0)
+        if substituted:
+            # cumulative: N old-snapshot requests were served LATEST params
+            # instead (missing/corrupt file) — eval results attributed to
+            # those epochs are suspect, and the books must say so
+            record["serve_snapshot_substituted"] = substituted
         if self._device_games > 0:
             # live plane topology (flips split -> fused after a watchdog
             # degradation) + cumulative watchdog events

@@ -50,6 +50,12 @@ class LocalModelServer:
         ).start()
         self.model_id = 0
         self._lock = threading.Lock()
+        # cumulative count of requested snapshots served as LATEST instead
+        # (missing / GC'd / corrupt file).  The substitution itself is the
+        # right degradation — but an eval book quietly scored against the
+        # wrong model must be VISIBLE, so the learner surfaces this in
+        # metrics.jsonl as serve_snapshot_substituted
+        self.substituted_snapshots = 0
 
     def publish(self, model_id: int, params) -> None:
         """Swap the served latest model (called by the learner per epoch)."""
@@ -85,7 +91,10 @@ class LocalModelServer:
             )
             return InferenceModel(self.module, {"params": params})
         except Exception:
-            # missing / GC'd / corrupt snapshot: serve latest instead
+            # missing / GC'd / corrupt snapshot: serve latest instead —
+            # counted, so a poisoned eval book shows up in metrics.jsonl
+            with self._lock:
+                self.substituted_snapshots += 1
             return self.engine.client()
 
 

@@ -1,0 +1,492 @@
+"""Continuous batcher: iteration-level scheduling for the serving plane.
+
+Generalizes ``BatchedInferenceEngine``'s drain loop (Orca-style): instead
+of filling a fixed batch and waiting out a batch boundary, the dispatcher
+assembles the NEXT device batch from whatever is queued the moment the
+previous dispatch is enqueued — a request that expires on the way to the
+device frees its bucket slot to the next queued request in the SAME
+gather pass, so slots recycle at iteration granularity, not batch
+granularity.
+
+Latency discipline (docs/serving.md §SLO semantics):
+
+* every request carries a deadline (caller-supplied, else now +
+  ``slo_ms``);
+* the admission controller fast-fails (``RequestShed``) when the
+  PREDICTED completion — queue depth in batch waves x the EMA batch
+  service time — already exceeds the deadline: under overload the queue
+  must stay shallow and reject quickly, never collapse into a backlog
+  where every admitted request is late (shed-fast beats serve-all-late);
+* a request whose deadline passes while queued is failed with
+  ``DeadlineExceeded`` at gather time, without spending a device slot.
+
+Device discipline: batches pad to the power-of-two buckets of
+``next_bucket`` (a handful of compiled shapes), ``warm()`` compiles them
+off the hot path (hot-swap warms the standby engine before the router
+flips), and every dispatch runs under ``dispatch_serialized`` with this
+engine's explicit device scope — engines of different models placed on
+different chips dispatch concurrently; engines sharing a chip serialize
+their enqueues (the DL002 invariant).  The host fetch happens OUTSIDE
+the device locks (``fetch_outputs``).
+
+Lifecycle is single-owner-drain (the ``BatchedInferenceEngine`` fix):
+submit/stop order through one lifecycle gate, and exactly one party —
+the serve thread, or ``stop()`` when none exists — fails the stragglers.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..models.inference import fetch_outputs
+from ..parallel.mesh import dispatch_serialized
+from ..runtime.inference_engine import EngineStopped, next_bucket, stack_padded
+from ..utils import tree_map
+
+__all__ = [
+    "ContinuousBatcher", "ServeError", "RequestShed", "DeadlineExceeded",
+    "BadRequest", "obs_spec",
+]
+
+
+class ServeError(RuntimeError):
+    """Base class for request-level serving failures (wire kind tag)."""
+
+    kind = "error"
+
+
+class RequestShed(ServeError):
+    """Admission controller fast-fail: the SLO budget is already spent."""
+
+    kind = "shed"
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline passed while it sat in the queue."""
+
+    kind = "deadline"
+
+
+class BadRequest(ServeError):
+    """The request's observation does not match the model's input spec."""
+
+    kind = "bad_request"
+
+
+def obs_spec(tree):
+    """Nested shape+dtype fingerprint of an observation pytree — the
+    admission gate's input contract.  One malformed obs must fail ITS OWN
+    future, never reach ``tree_stack`` where it would poison every
+    co-batched request with a stacking error — and dtype is part of the
+    contract: a wrong-dtype batch is a fresh jit signature, i.e. a
+    hot-path compile a single client could trigger at will."""
+    if isinstance(tree, dict):
+        return {k: obs_spec(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return tuple(obs_spec(v) for v in tree)
+    dtype = getattr(tree, "dtype", None)
+    return (np.shape(tree), None if dtype is None else np.dtype(dtype).str)
+
+
+class _Request:
+    __slots__ = ("obs", "hidden", "fut", "deadline", "t0")
+
+    def __init__(self, obs, hidden, fut, deadline, t0):
+        self.obs = obs
+        self.hidden = hidden
+        self.fut = fut
+        self.deadline = deadline
+        self.t0 = t0
+
+
+class _LatencyRing:
+    """Fixed-size reservoir of recent request latencies (ms).
+
+    A ring, not a full history: the serving percentiles must reflect the
+    CURRENT operating point (post-swap, post-load-change), and an
+    unbounded list would grow for the life of the server."""
+
+    def __init__(self, size: int = 4096):
+        self._buf = [0.0] * size
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def add(self, ms: float) -> None:
+        with self._lock:
+            self._buf[self._n % len(self._buf)] = ms
+            self._n += 1
+
+    def snapshot(self) -> List[float]:
+        with self._lock:
+            if self._n >= len(self._buf):
+                return list(self._buf)
+            return self._buf[: self._n]
+
+
+def percentiles_ms(samples: Sequence[float], qs=(50, 99)) -> Dict[int, Optional[float]]:
+    """Nearest-rank percentiles of a latency sample (None when empty)."""
+    if not samples:
+        return {q: None for q in qs}
+    ordered = sorted(samples)
+    out = {}
+    for q in qs:
+        idx = min(len(ordered) - 1, max(0, int(round(q / 100.0 * len(ordered))) - 1))
+        out[q] = ordered[idx]
+    return out
+
+
+class ContinuousBatcher:
+    """One model's serving engine: iteration-level batched inference with
+    per-request deadlines and load shedding."""
+
+    def __init__(
+        self,
+        model,
+        devices,
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+        slo_ms: float = 200.0,
+        shed_policy: str = "deadline",
+        queue_bound: int = 1024,
+        template_obs=None,
+    ):
+        import jax
+
+        self.model = model
+        # variables committed to this engine's device at construction (off
+        # the hot path): the jitted apply then runs there, so the router
+        # can spread model engines across chips and their dispatches —
+        # holding disjoint device locks — overlap
+        self._devices = list(devices)
+        self.model.variables = jax.device_put(self.model.variables, self._devices[0])
+        self.max_batch = max(1, int(max_batch))
+        self.max_wait = float(max_wait_ms) / 1000.0
+        self.slo_s = float(slo_ms) / 1000.0
+        self.shed_policy = shed_policy
+        self.queue_bound = max(1, int(queue_bound))
+        self._obs_spec = None if template_obs is None else obs_spec(template_obs)
+        hidden_template = self.model.init_hidden()
+        self._hidden_spec = (
+            None if hidden_template is None else obs_spec(hidden_template)
+        )
+        self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._gate = threading.Lock()  # lifecycle + admission state
+        self._sealed = False           # drain mode: no new admissions
+        self._depth = 0                # admitted, not yet gathered
+        self._inflight = 0             # gathered, dispatch not yet scattered
+        self._ema_batch_s: Optional[float] = None
+        # counters: admitted/shed move under the gate; the rest are only
+        # touched by the single dispatcher thread
+        self.requests_admitted = 0
+        self.requests_served = 0
+        self.requests_shed = 0
+        self.deadline_misses = 0
+        self.batches_served = 0
+        self.buckets_warmed: List[int] = []
+        # bucket sizes whose compile has already been paid (warm() seeds
+        # these): a bucket's FIRST execution is compile-dominated and must
+        # not feed the service-time EMA — one 300ms compile read as the
+        # steady service rate would shed every future request, and with
+        # nothing admitted the estimate could never recover
+        self._timed_buckets: set = set()
+        self._latency = _LatencyRing()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ContinuousBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._serve_loop, daemon=True, name="serve-batcher"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._gate:
+            if self._stop.is_set():
+                return
+            self._stop.set()
+            self._queue.put(None)  # wake the dispatcher
+            thread = self._thread
+        if thread is None:
+            self._fail_pending()
+
+    def join(self, timeout: float = 5.0) -> None:
+        """Wait for the serve thread to fully exit (after stop): its last
+        counter increments happen after drain waiters can already observe
+        an empty queue, so readers of FINAL counters join first."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def seal(self) -> None:
+        """Refuse new admissions; everything already admitted completes."""
+        with self._gate:
+            self._sealed = True
+
+    def drain_and_stop(self, timeout: float = 30.0) -> bool:
+        """Zero-drop retirement: seal, wait for the queue AND the in-flight
+        batch to finish, then stop.  Returns False when the timeout fired
+        with work still pending (that work is then failed by stop())."""
+        self.seal()
+        deadline = time.monotonic() + timeout
+        drained = False
+        while time.monotonic() < deadline:
+            with self._gate:
+                if self._depth == 0 and self._inflight == 0:
+                    drained = True
+                    break
+            time.sleep(0.002)
+        self.stop()
+        return drained
+
+    def _fail_pending(self) -> None:
+        """Single-owner final drain (see BatchedInferenceEngine): runs on
+        the serve thread after it observes stop, or inside stop() when the
+        engine never started."""
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            with self._gate:
+                self._depth -= 1
+            if not item.fut.done():
+                item.fut.set_exception(EngineStopped("serving engine stopped"))
+
+    # -- client API ---------------------------------------------------------
+
+    def submit(self, obs, hidden=None, deadline: Optional[float] = None) -> Future:
+        """Queue one request; the future resolves to the numpy output tree
+        or raises RequestShed / DeadlineExceeded / EngineStopped.  A shed
+        decision is made HERE, synchronously — fast-fail is the contract."""
+        fut: Future = Future()
+        now = time.monotonic()
+        if deadline is None and self.shed_policy != "none":
+            # 'none' is drain semantics — every admitted request completes,
+            # so no default budget is imposed; a caller-supplied deadline
+            # (explicit slo_ms in the frame) is still honored
+            deadline = now + self.slo_s
+        if self._obs_spec is not None and obs_spec(obs) != self._obs_spec:
+            fut.set_exception(BadRequest(
+                "observation does not match the model's input spec"
+            ))
+            return fut
+        if hidden is not None:
+            # same isolation contract as obs: a malformed hidden must fail
+            # ITS request, never the whole batch at tree_stack
+            if self._hidden_spec is None or obs_spec(hidden) != self._hidden_spec:
+                fut.set_exception(BadRequest(
+                    "hidden state does not match the model's recurrent spec"
+                ))
+                return fut
+        with self._gate:
+            if self._sealed or self._stop.is_set():
+                fut.set_exception(EngineStopped("serving engine stopped"))
+                return fut
+            why = self._admission_check(now, deadline)
+            if why is not None:
+                self.requests_shed += 1
+                fut.set_exception(RequestShed(why))
+                return fut
+            self.requests_admitted += 1
+            self._depth += 1
+            self._queue.put(_Request(obs, hidden, fut, deadline, now))
+        return fut
+
+    def _admission_check(self, now: float, deadline: float) -> Optional[str]:
+        """None = admit; else the shed reason.  Caller holds the gate."""
+        if self.shed_policy == "none":
+            return None
+        if self._depth == 0 and not self._inflight:
+            # idle engine: the only wait ahead is the request's own service
+            # time — serve it.  This is also the estimator's recovery
+            # valve: a transient stall (GC pause, noisy neighbor) that
+            # inflated the EMA would otherwise shed every request, run no
+            # batches, and freeze the bad estimate in place forever
+            return None
+        if self._depth >= self.queue_bound:
+            return f"queue depth {self._depth} at bound {self.queue_bound}"
+        if self.shed_policy == "deadline" and self._ema_batch_s is not None:
+            # batch waves ahead of this request: the queue in front of it,
+            # itself, and the batch currently on the device
+            waves = self._depth // self.max_batch + 1 + (1 if self._inflight else 0)
+            predicted = now + waves * self._ema_batch_s
+            if predicted > deadline:
+                budget_ms = (deadline - now) * 1000.0
+                return (
+                    f"predicted completion {waves} batch wave(s) x "
+                    f"{self._ema_batch_s * 1000.0:.1f}ms exceeds the "
+                    f"{budget_ms:.1f}ms SLO budget"
+                )
+        return None
+
+    # -- dispatcher ---------------------------------------------------------
+
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            requests = self._gather()
+            if not requests:
+                continue
+            try:
+                self._execute(requests)
+            except Exception as exc:  # propagate to every waiter
+                for r in requests:
+                    if not r.fut.done():
+                        r.fut.set_exception(exc)
+            finally:
+                with self._gate:
+                    self._inflight = 0
+        self._fail_pending()
+
+    def _take(self, req: _Request, live: List[_Request], now: float) -> None:
+        """Admit one popped request into the forming batch — or expire it,
+        FREEING its slot to whatever the gather pulls next (the
+        iteration-level property: an expiry never wastes device work)."""
+        expired = req.deadline is not None and now > req.deadline
+        with self._gate:
+            # depth -> inflight moves atomically per LIVE request, so a
+            # drain_and_stop poll can never observe zero/zero while the
+            # forming batch holds real work (e.g. during the straggler wait)
+            self._depth -= 1
+            if not expired:
+                self._inflight += 1
+        if expired:
+            self.deadline_misses += 1
+            if not req.fut.done():
+                req.fut.set_exception(DeadlineExceeded(
+                    f"deadline passed {(now - req.deadline) * 1000.0:.1f}ms "
+                    "before dispatch"
+                ))
+            return
+        live.append(req)
+
+    def _gather(self) -> List[_Request]:
+        """Form the next device batch: block for the first live request,
+        then sweep everything already queued, waiting at most ``max_wait``
+        for stragglers once the queue runs dry."""
+        item = self._queue.get()
+        live: List[_Request] = []
+        first_t = time.monotonic()
+        while True:
+            if item is None:
+                break  # stop token; the loop condition handles the rest
+            self._take(item, live, time.monotonic())
+            if len(live) >= self.max_batch:
+                break
+            try:
+                item = self._queue.get_nowait()
+                continue
+            except queue.Empty:
+                pass
+            if not live:
+                if self._stop.is_set():
+                    break
+                item = self._queue.get()  # everything expired: block again
+                first_t = time.monotonic()
+                continue
+            remaining = (first_t + self.max_wait) - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                item = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+        return live  # _take already accounted every live request as in flight
+
+    def _execute(self, requests: List[_Request]) -> None:
+        model = self.model
+        n = len(requests)
+        bucket = next_bucket(n, self.max_batch)
+        obs_batch, hidden_batch = stack_padded(
+            [r.obs for r in requests], [r.hidden for r in requests],
+            bucket, model.init_hidden(),
+        )
+        t0 = time.monotonic()
+        device_out = dispatch_serialized(
+            lambda: model.inference_batch_async(obs_batch, hidden_batch),
+            self._devices,
+        )
+        outputs = fetch_outputs(device_out)  # host fetch outside the locks
+        done = time.monotonic()
+        self._note_batch(done - t0, bucket)
+        with self._gate:
+            # the device work is over: a waiter woken by the scatter below
+            # must not see this batch as still in flight (its re-submit
+            # would be predicted one wave late; the serve loop's finally
+            # remains the backstop on the exception path)
+            self._inflight = 0
+
+        for i, r in enumerate(requests):
+            if not r.fut.done():
+                r.fut.set_result(tree_map(lambda x: x[i], outputs))
+            self._latency.add((done - r.t0) * 1000.0)
+        self.batches_served += 1
+        self.requests_served += n
+
+    def _note_batch(self, seconds: float, bucket: int) -> None:
+        if bucket not in self._timed_buckets:
+            # first execution at this bucket: compile-dominated, not a
+            # service-time sample (see _timed_buckets)
+            self._timed_buckets.add(bucket)
+            return
+        if self._ema_batch_s is None:
+            self._ema_batch_s = seconds
+        else:
+            self._ema_batch_s = 0.8 * self._ema_batch_s + 0.2 * seconds
+
+    # -- warm-up ------------------------------------------------------------
+
+    def warm(self, buckets: Sequence[int], template_obs, template_hidden=None) -> float:
+        """Compile each bucket shape off the hot path (dummy batches from
+        the template observation); returns wall ms.  The hot-swap router
+        runs this on the STANDBY engine before flipping, so the first
+        post-swap request never pays an XLA compile."""
+        t0 = time.monotonic()
+        model = self.model
+        template = model.init_hidden() if template_hidden is None else template_hidden
+        for b in sorted({max(1, min(int(x), self.max_batch)) for x in buckets}):
+            obs_batch, hidden_batch = stack_padded(
+                [template_obs] * b, [None] * b, b, template
+            )
+            device_out = dispatch_serialized(
+                lambda: model.inference_batch_async(obs_batch, hidden_batch),
+                self._devices,
+            )
+            fetch_outputs(device_out)  # realized: the compile has finished
+            self.buckets_warmed.append(b)
+            self._timed_buckets.add(b)  # compile paid: future runs are samples
+        return (time.monotonic() - t0) * 1000.0
+
+    # -- introspection ------------------------------------------------------
+
+    def latencies_ms(self) -> List[float]:
+        return self._latency.snapshot()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._gate:
+            depth = self._depth
+            inflight = self._inflight
+            ema = self._ema_batch_s
+        pct = percentiles_ms(self.latencies_ms())
+        return {
+            "requests_admitted": self.requests_admitted,
+            "requests_served": self.requests_served,
+            "requests_shed": self.requests_shed,
+            "deadline_misses": self.deadline_misses,
+            "batches_served": self.batches_served,
+            "depth": depth,
+            "inflight": inflight,
+            "ema_batch_ms": None if ema is None else ema * 1000.0,
+            "p50_ms": pct[50],
+            "p99_ms": pct[99],
+        }

@@ -1,0 +1,133 @@
+"""Serving-plane client: pipelined request/reply over one framed socket.
+
+One connection, many outstanding requests: every frame carries a ``rid``
+and a single receiver thread resolves the matching future, so a caller
+can keep a submit window open (the load generator the bench uses) or use
+the blocking ``infer`` facade.  Server-side sheds and deadline misses
+surface as ``ServingError`` with the wire ``kind`` — fast-fail reaches
+the caller as an exception, never as a hang.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..runtime.connection import connect_socket_connection
+from ..utils import tree_map
+
+__all__ = ["ServingClient", "ServingError"]
+
+
+class ServingError(RuntimeError):
+    """Server-reported request failure; ``kind`` is the wire tag
+    (shed / deadline / stopped / bad_request / swap_failed / ...)."""
+
+    def __init__(self, kind: str, msg: str):
+        super().__init__(f"[{kind}] {msg}")
+        self.kind = kind
+
+
+class ServingClient:
+    def __init__(self, host: str, port: int, timeout: float = 60.0,
+                 retry_seconds: float = 0.0):
+        self.conn = connect_socket_connection(
+            host, int(port), timeout=timeout, retry_seconds=retry_seconds
+        )
+        self._lock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._rid = 0
+        self._closed = False
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, daemon=True, name="serve-client-recv"
+        )
+        self._recv_thread.start()
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _recv_loop(self) -> None:
+        while True:
+            try:
+                kind, data = self.conn.recv(timeout=None)
+            except Exception:
+                self._fail_all(ConnectionResetError("serving connection lost"))
+                return
+            if kind == "heartbeat" or kind == "__hb__":
+                continue
+            rid = (data or {}).get("rid") if isinstance(data, dict) else None
+            with self._lock:
+                fut = self._pending.pop(rid, None)
+            if fut is None or fut.done():
+                continue
+            if kind == "error":
+                fut.set_exception(
+                    ServingError(data.get("kind", "error"), data.get("msg", ""))
+                )
+            elif kind == "stats":
+                fut.set_result(data.get("stats"))
+            else:  # result / swapped
+                fut.set_result(data)
+
+    def _fail_all(self, exc: Exception) -> None:
+        with self._lock:
+            pending, self._pending = dict(self._pending), {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+
+    def _send(self, req: str, data: Dict[str, Any]) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                fut.set_exception(ConnectionResetError("client closed"))
+                return fut
+            self._rid += 1
+            rid = self._rid
+            self._pending[rid] = fut
+        try:
+            self.conn.send((req, dict(data, rid=rid)))
+        except Exception as exc:
+            with self._lock:
+                self._pending.pop(rid, None)
+            if not fut.done():
+                fut.set_exception(exc)
+        return fut
+
+    # -- API ----------------------------------------------------------------
+
+    def submit(self, obs, model=-1, hidden=None,
+               slo_ms: Optional[float] = None) -> Future:
+        """Async inference; resolves to {"model": served_id, "out": tree}."""
+        data: Dict[str, Any] = {"model": model, "obs": obs}
+        if hidden is not None:
+            data["hidden"] = hidden
+        if slo_ms is not None:
+            data["slo_ms"] = float(slo_ms)
+        return self._send("infer", data)
+
+    def infer(self, obs, model=-1, hidden=None, slo_ms: Optional[float] = None,
+              timeout: float = 60.0) -> Dict[str, Any]:
+        return self.submit(obs, model, hidden, slo_ms).result(timeout=timeout)
+
+    def stats(self, timeout: float = 30.0) -> Dict[str, Any]:
+        return self._send("stats", {}).result(timeout=timeout)
+
+    def swap(self, model_id: int, params=None, timeout: float = 300.0) -> Dict[str, Any]:
+        """Hot-swap the served latest to ``model_id`` (params inline, or
+        loaded digest-verified from the server's model dir when None).
+        Blocks until the standby engine is warm and the flip happened."""
+        data: Dict[str, Any] = {"id": int(model_id)}
+        if params is not None:
+            # the wire codec speaks numpy pytrees; a device-resident params
+            # tree (fresh from a train step) converts here, once
+            data["params"] = tree_map(np.asarray, params)
+        return self._send("swap", data).result(timeout=timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        self.conn.close()
+        self._fail_all(ConnectionResetError("client closed"))

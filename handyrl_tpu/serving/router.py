@@ -1,0 +1,501 @@
+"""Multi-model router: N verified snapshots (or ensembles of them) served
+concurrently, with zero-downtime hot-swap.
+
+Routing contract (mirrors ``LocalModelServer.get`` so training-side model
+ids keep their meaning on the serving plane):
+
+* ``-1`` (or any id newer than the latest) — the latest published model;
+* ``0`` — the zero-output RandomModel (an instant, device-free route:
+  the well-defined baseline opponent, and a useful shed-free yardstick);
+* a concrete epoch — that snapshot's resident engine, loaded
+  digest-verified from the checkpoint manifest on first use (PR 2
+  machinery); a snapshot that is missing/corrupt substitutes the latest
+  engine and INCREMENTS ``substituted`` — never a silent swap;
+* a list of ids — an ensemble route: one inference per member engine,
+  outputs mean-pooled (the ensemble-first dispatch of ``agents.py``).
+
+Hot-swap sequence (docs/serving.md §Hot-swap): ``publish`` builds the new
+engine OFF the hot path, warms its power-of-two buckets (compiles
+finish before any client can reach it), then flips the latest pointer
+under the routing lock — one atomic reference swap.  The old engine
+stays resident and keeps serving its queued + explicitly-routed
+requests on the OLD params; when ``max_models`` evicts it, retirement is
+``drain_and_stop`` (seal, complete everything admitted, then stop) on a
+background thread — zero requests dropped, pinned by
+tests/test_serving.py::test_hot_swap_under_load_drops_nothing.
+
+Device placement: engines round-robin over the router's device list, so
+distinct models land on distinct chips where available and their
+dispatches (disjoint ``dispatch_serialized`` scopes) overlap; co-located
+engines serialize their enqueues, which is exactly the DL002 invariant.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..agents import mean_pool_outputs
+from ..models import InferenceModel, RandomModel
+from ..runtime.checkpoint import latest_verified_epoch, load_verified_params
+from .batcher import BadRequest, ContinuousBatcher, ServeError, percentiles_ms
+
+__all__ = ["ModelRouter", "EnsembleRoute", "RouteError", "ColdRoute"]
+
+ModelId = Union[int, Sequence[int]]
+
+
+class RouteError(ServeError):
+    """No servable route for the requested model id."""
+
+    kind = "bad_request"
+
+
+class ColdRoute(Exception):
+    """Control flow, not an error: resolving this id needs cold work (disk
+    load / warm compiles / waiting on another loader).  Raised only under
+    ``allow_cold=False`` so a latency-critical caller (the server's
+    dispatch thread) can hand the request to a worker instead — closing
+    the check-then-resolve race a separate is_resident probe would leave
+    open."""
+
+
+class _InstantRoute:
+    """Model id 0: the zero-output RandomModel, resolved host-side with no
+    device round-trip (its futures complete synchronously)."""
+
+    def __init__(self, random_model: RandomModel):
+        self._random = random_model
+
+    def submit(self, obs, hidden=None, deadline=None) -> Future:
+        fut: Future = Future()
+        fut.set_result(self._random.inference(obs, hidden))
+        return fut
+
+
+class EnsembleRoute:
+    """Mean-pooled multi-member route (Agent._forward semantics): one
+    submit per member engine — they batch independently, possibly on
+    different chips — and the combined future resolves when the last
+    member lands.  Hidden state is not pooled (pooling recurrent state is
+    meaningless); ensemble replies omit it."""
+
+    def __init__(self, members: List[Tuple[int, ContinuousBatcher]]):
+        self.members = members
+
+    def submit(self, obs, hidden=None, deadline=None) -> Future:
+        out: Future = Future()
+        if hidden is not None:
+            # cannot be honored (per-member recurrent state lives with the
+            # caller, Agent-style) — refusing beats silently running every
+            # member from initial state and returning wrong outputs
+            out.set_exception(BadRequest(
+                "ensemble routes cannot thread recurrent state; track "
+                "per-member hidden client-side and submit per member"
+            ))
+            return out
+        futs = [engine.submit(obs, None, deadline) for _, engine in self.members]
+        # a member that failed SYNCHRONOUSLY (sealed engine racing an
+        # eviction, shed) fails the combined future now, while the server's
+        # re-resolve-once retry can still see it — waiting for the slow
+        # members would surface the same failure asynchronously, past the
+        # retry window
+        for f in futs:
+            exc = f.exception() if f.done() else None
+            if exc is not None:
+                out.set_exception(exc)
+                return out
+        pending = [len(futs)]
+        lock = threading.Lock()
+
+        def _one_done(_f):
+            with lock:
+                pending[0] -= 1
+                if pending[0]:
+                    return
+            for f in futs:
+                exc = f.exception()
+                if exc is not None:
+                    if not out.done():
+                        out.set_exception(exc)
+                    return
+            pooled = mean_pool_outputs([f.result() for f in futs])
+            if not out.done():
+                out.set_result(pooled)
+
+        for f in futs:
+            f.add_done_callback(_one_done)
+        return out
+
+
+class ModelRouter:
+    """Routes request model-ids to resident ContinuousBatcher engines."""
+
+    def __init__(
+        self,
+        module,
+        template_obs,
+        serving_cfg: Dict[str, Any],
+        model_dir: str = "models",
+        devices=None,
+    ):
+        import jax
+
+        self.module = module
+        self.model_dir = model_dir
+        self._template_obs = template_obs
+        cfg = dict(serving_cfg or {})
+        self.max_models = max(1, int(cfg.get("max_models", 4)))
+        self.warm_buckets = [int(b) for b in cfg.get("warm_buckets", (1, 8))]
+        self._engine_cfg = {
+            "max_batch": int(cfg.get("max_batch", 64)),
+            "max_wait_ms": float(cfg.get("max_wait_ms", 2.0)),
+            "slo_ms": float(cfg.get("slo_ms", 200.0)),
+            "shed_policy": cfg.get("shed_policy", "deadline"),
+            "queue_bound": int(cfg.get("queue_bound", 1024)),
+        }
+        self._devices = list(devices) if devices is not None else list(jax.devices())
+        self._spawned = 0
+        self._lock = threading.Lock()
+        self._engines: Dict[int, ContinuousBatcher] = {}
+        self._touched: Dict[int, float] = {}
+        self._latest_id: Optional[int] = None
+        self._random: Optional[_InstantRoute] = None
+        self._retiring: List[threading.Thread] = []
+        # engines popped from the routing table but still draining: stats
+        # must keep counting them (a popped engine's 10k served requests
+        # vanishing for the drain window would read as a negative qps
+        # downstream), and their FINAL counters fold into _retired_totals
+        # once the serve thread has fully exited
+        self._draining: List[ContinuousBatcher] = []
+        self._retired_totals: Dict[str, int] = {}
+        # one loader per cold snapshot id: a burst of requests for the
+        # same non-resident epoch must pay ONE disk load + warm, not N
+        self._loading: Dict[int, Future] = {}
+        # terminal flag: a cold load or publish racing stop() must not
+        # re-register a live engine into the cleared routing table (a
+        # serve-thread + device-memory leak), nor surface as a KeyError
+        self._stopped = False
+        self.hot_swaps = 0
+        self.substituted = 0
+        self.last_warm_ms: Optional[float] = None
+
+    # -- engine construction / hot-swap --------------------------------------
+
+    def _spawn(self, model: InferenceModel) -> ContinuousBatcher:
+        device = self._devices[self._spawned % len(self._devices)]
+        self._spawned += 1
+        return ContinuousBatcher(
+            model, [device], template_obs=self._template_obs, **self._engine_cfg
+        ).start()
+
+    def publish(self, model_id: int, params, warm: bool = True) -> float:
+        """Serve ``params`` as ``model_id`` and make it the latest: build +
+        warm the standby engine off the hot path, then flip atomically.
+        Returns the warm-up wall ms (the pre-paid part of
+        time-to-first-response)."""
+        model = InferenceModel(self.module, {"params": params})
+        engine = self._spawn(model)
+        warm_ms = engine.warm(self.warm_buckets, self._template_obs) if warm else 0.0
+        with self._lock:
+            if self._stopped:
+                displaced = None
+            else:
+                prev = self._latest_id
+                displaced = self._engines.pop(int(model_id), None)
+                if displaced is not None:
+                    self._draining.append(displaced)  # atomic with the pop
+                self._engines[int(model_id)] = engine
+                self._touched[int(model_id)] = time.monotonic()
+                self._latest_id = int(model_id)
+                if prev is not None and prev != int(model_id):
+                    self.hot_swaps += 1
+                self.last_warm_ms = warm_ms
+            stopped = self._stopped
+        if stopped:  # raced shutdown: nothing may re-register
+            engine.stop()
+            raise RouteError("router stopped")
+        if displaced is not None:  # republished id: retire the old engine
+            self._retire(displaced)
+        self._evict_over_capacity()
+        return warm_ms
+
+    def maybe_refresh(self) -> Optional[int]:
+        """Publish the newest manifest-verified snapshot if it is newer
+        than the served latest (the checkpoint-watcher entry point).
+        Returns the epoch published, or None."""
+        newest = latest_verified_epoch(self.model_dir)
+        with self._lock:
+            current = self._latest_id
+        if newest <= 0 or (current is not None and newest <= current):
+            return None
+        params = load_verified_params(
+            self.model_dir, newest, self._params_template(), pre_verified=True
+        )
+        self.publish(newest, params)
+        return newest
+
+    def _params_template(self):
+        with self._lock:
+            if self._latest_id is None:
+                raise RouteError("no model published yet")
+            return self._engines[self._latest_id].model.variables["params"]
+
+    _COUNTER_KEYS = (
+        "requests_admitted", "requests_served", "requests_shed",
+        "deadline_misses", "batches_served",
+    )
+
+    def _fold_retired(self, engine: ContinuousBatcher) -> None:
+        stats = engine.stats()
+        with self._lock:
+            # atomic hand-off from live-summed to folded: an engine must
+            # never be counted in both places, or in neither
+            if engine in self._draining:
+                self._draining.remove(engine)
+            for key in self._COUNTER_KEYS:
+                self._retired_totals[key] = (
+                    self._retired_totals.get(key, 0) + stats[key]
+                )
+
+    def _retire(self, engine: ContinuousBatcher) -> None:
+        """Start the drain-then-fold for an engine the caller has ALREADY
+        moved from ``_engines`` into ``_draining`` under the routing lock —
+        the pop and the append must share one acquisition, or a stats()
+        reader in between sees the engine's counters nowhere."""
+        def _drain_then_fold():
+            engine.drain_and_stop()
+            # join the serve thread before reading final counters: its last
+            # requests_served increment happens after the drain wait's
+            # depth/inflight condition can already observe zero
+            engine.join()
+            self._fold_retired(engine)
+
+        t = threading.Thread(target=_drain_then_fold, daemon=True,
+                             name="serve-retire")
+        with self._lock:
+            # prune finished retirements: a server following a training run
+            # retires one engine per swap for its whole life
+            self._retiring = [x for x in self._retiring if x.is_alive()]
+            self._retiring.append(t)
+        t.start()
+
+    def _evict_over_capacity(self, protect: Optional[int] = None) -> None:
+        """``protect`` exempts the engine a resolve JUST spawned: retiring
+        it before its own request submits would both waste the warm
+        compile and intermittently fail the request (at max_models=1 it
+        would be the only candidate).  Capacity may exceed by one until
+        the next publish/resolve, when the engine is evictable like any
+        other resident."""
+        doomed: List[ContinuousBatcher] = []
+        with self._lock:
+            while len(self._engines) > self.max_models:
+                # LRU among the non-latest residents; the latest is pinned
+                candidates = [
+                    k for k in self._engines
+                    if k != self._latest_id and k != protect
+                ]
+                if not candidates:
+                    break
+                lru = min(candidates, key=lambda k: self._touched.get(k, 0.0))
+                engine = self._engines.pop(lru)
+                self._draining.append(engine)  # atomic with the pop
+                doomed.append(engine)
+                self._touched.pop(lru, None)
+        for engine in doomed:
+            self._retire(engine)
+
+    # -- routing -------------------------------------------------------------
+
+    def resolve(self, model_id: ModelId, allow_cold: bool = True):
+        """(served_key, route) for a request's model id.  served_key is
+        what reply frames report — the concrete id actually serving, so a
+        client sees the flip the moment it happens.  ``allow_cold=False``
+        raises ColdRoute instead of paying disk loads / warm compiles."""
+        if isinstance(model_id, (list, tuple)):
+            members: List[Tuple[int, ContinuousBatcher]] = []
+            for mid in model_id:
+                key, engine = self._resolve_single(int(mid), allow_cold)
+                if not isinstance(engine, ContinuousBatcher):
+                    raise RouteError(
+                        f"ensemble member {mid} is not an engine-backed route"
+                    )
+                members.append((key, engine))
+            if not members:
+                raise RouteError("empty ensemble")
+            return tuple(k for k, _ in members), EnsembleRoute(members)
+        return self._resolve_single(int(model_id), allow_cold)
+
+    def _resolve_single(self, mid: int, allow_cold: bool = True):
+        with self._lock:
+            if self._stopped:
+                raise RouteError("router stopped")
+        if mid == 0:
+            with self._lock:
+                unbuilt = self._random is None
+            if unbuilt and not allow_cold:
+                raise ColdRoute(mid)
+            return 0, self._ensure_random()
+        with self._lock:
+            latest = self._latest_id
+            if latest is None:
+                raise RouteError("no model published yet")
+            if mid < 0 or mid >= latest:
+                self._touched[latest] = time.monotonic()
+                return latest, self._engines[latest]
+            engine = self._engines.get(mid)
+            if engine is not None:
+                self._touched[mid] = time.monotonic()
+                return mid, engine
+        # old snapshot: digest-verified disk load, engine spun on demand —
+        # exactly ONE loader per id; a concurrent burst for the same cold
+        # epoch waits on the loader's future instead of each paying the
+        # load + device_put + warm-up compiles again
+        if not allow_cold:
+            raise ColdRoute(mid)
+        with self._lock:
+            pending = self._loading.get(mid)
+            if pending is None:
+                pending = Future()
+                self._loading[mid] = pending
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            engine = pending.result(timeout=600.0)
+            if engine is None:  # the loader substituted: so do we, counted
+                return self._substitute_latest()
+            with self._lock:
+                self._touched[mid] = time.monotonic()
+            return mid, engine
+        try:
+            params = load_verified_params(
+                self.model_dir, mid, self._params_template()
+            )
+            engine = self._spawn(InferenceModel(self.module, {"params": params}))
+            engine.warm(self.warm_buckets, self._template_obs)
+        except Exception:
+            # missing / GC'd / corrupt snapshot (or a failed spawn):
+            # substitute latest, COUNTED (the silent-substitution lesson
+            # from LocalModelServer.get) — and release the waiters
+            with self._lock:
+                self._loading.pop(mid, None)
+            pending.set_result(None)
+            return self._substitute_latest()
+        with self._lock:
+            if self._stopped:
+                registered = None  # shutdown won: nothing may re-register
+            else:
+                raced = self._engines.get(mid)
+                if raced is None:
+                    self._engines[mid] = engine
+                    registered = engine
+                else:
+                    # a publish() of this very id won the race: its engine
+                    # is the routing truth — ours retires instead of
+                    # silently displacing it (which would leak a live serve
+                    # thread and its device-resident params)
+                    registered = raced
+                self._touched[mid] = time.monotonic()
+            self._loading.pop(mid, None)
+        pending.set_result(registered)
+        if registered is None:
+            engine.stop()
+            raise RouteError("router stopped")
+        if registered is not engine:
+            engine.stop()  # nothing was ever admitted to it
+        else:
+            self._evict_over_capacity(protect=mid)
+        return mid, registered
+
+    def _substitute_latest(self):
+        with self._lock:
+            latest = self._latest_id
+            engine = None if latest is None else self._engines.get(latest)
+            if engine is None:  # stopped (or nothing published) mid-race
+                raise RouteError(
+                    "router stopped" if self._stopped else "no model published yet"
+                )
+            self.substituted += 1
+            self._touched[latest] = time.monotonic()
+            return latest, engine
+
+    def _ensure_random(self) -> _InstantRoute:
+        with self._lock:
+            if self._random is not None:
+                return self._random
+            if self._latest_id is None:
+                raise RouteError("no model published yet")
+            engine = self._engines[self._latest_id]
+        # output spec from one engine round-trip (through the engine's own
+        # locks, not a bare device call)
+        out = engine.submit(self._template_obs).result(timeout=60.0)
+        spec = {
+            k: (np.shape(v), np.asarray(v).dtype)
+            for k, v in out.items()
+            if k != "hidden" and v is not None
+        }
+        with self._lock:
+            if self._random is None:
+                self._random = _InstantRoute(RandomModel(spec))
+            return self._random
+
+    # -- introspection / teardown --------------------------------------------
+
+    def latest_id(self) -> Optional[int]:
+        with self._lock:
+            return self._latest_id
+
+    def routes(self) -> List[int]:
+        with self._lock:
+            return sorted(self._engines)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            # ONE consistent cut: draining engines still count (their work
+            # must not vanish for the drain window), and the retired totals
+            # copy under the SAME acquisition — fold-in moves an engine
+            # from _draining to _retired_totals atomically, so splitting
+            # these reads across two acquisitions could count a
+            # just-folded engine in both
+            engines = list(self._engines.values()) + list(self._draining)
+            n_models = len(self._engines)
+            retired = dict(self._retired_totals)
+        per_engine = [e.stats() for e in engines]
+        samples: List[float] = []
+        for e in engines:
+            samples.extend(e.latencies_ms())
+        pct = percentiles_ms(samples)
+        total = lambda key: sum(s[key] for s in per_engine) + retired.get(key, 0)
+        return {
+            "models": n_models,
+            "requests_admitted": total("requests_admitted"),
+            "requests_served": total("requests_served"),
+            "requests_shed": total("requests_shed"),
+            "deadline_misses": total("deadline_misses"),
+            "batches_served": total("batches_served"),
+            "hot_swaps": self.hot_swaps,
+            "substituted": self.substituted,
+            "last_warm_ms": self.last_warm_ms,
+            "p50_ms": pct[50],
+            "p99_ms": pct[99],
+        }
+
+    def stop(self, drain: bool = False, timeout: float = 10.0) -> None:
+        with self._lock:
+            self._stopped = True
+            engines = list(self._engines.values())
+            self._engines.clear()
+            self._touched.clear()
+            retiring = list(self._retiring)
+        for engine in engines:
+            if drain:
+                engine.drain_and_stop(timeout)
+            else:
+                engine.stop()
+        for t in retiring:
+            t.join(timeout)

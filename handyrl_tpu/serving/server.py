@@ -1,0 +1,406 @@
+"""Serving-plane network front: framed-socket request/reply over the
+actor-plane transport.
+
+Reuses the fault-tolerant pieces of ``runtime/connection.py`` unchanged:
+length-prefixed codec frames, per-peer bounded send queues with sender
+threads (one stalled client can never wedge replies to the rest), and
+optional silent-peer reaping.  On top of that, one dispatch thread pulls
+request frames off the hub and hands them to the router — inference
+itself is asynchronous (the reply is sent from a future callback on the
+owning engine's dispatcher thread), so a slow batch never blocks frame
+intake, which is what lets thousands of connections share one server.
+
+Wire protocol (codec frames, all request/reply pairs carry ``rid``):
+
+    -> ("infer", {"rid", "model", "obs", "hidden"?, "slo_ms"?})
+    <- ("result", {"rid", "model": served_id, "out": numpy tree})
+    <- ("error",  {"rid", "kind": shed|deadline|stopped|bad_request|..., "msg"})
+    -> ("stats", {"rid"})               <- ("stats", {"rid", "stats": {...}})
+    -> ("swap",  {"rid", "id", "params"?})  <- ("swapped", {"rid", "id", "warm_ms"})
+    -> ("heartbeat", None)              (liveness only, never replied)
+
+``swap`` with no params loads ``{id}.ckpt`` digest-verified from the
+checkpoint manifest; the warm-then-flip sequence lives in the router.
+A ``watch_interval`` > 0 arms a manifest watcher that hot-swaps
+automatically when training publishes a newer verified snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue as _queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..models import init_variables
+from ..runtime.checkpoint import latest_verified_epoch, load_verified_params
+from ..runtime.connection import (
+    FramedConnection,
+    QueueCommunicator,
+    open_socket_connection,
+    accept_socket_connections,
+)
+from ..runtime.inference_engine import EngineStopped
+from .router import ColdRoute, ModelRouter
+
+__all__ = ["ServingServer", "serve_main"]
+
+
+class ServingServer(QueueCommunicator):
+    """Continuous-batching inference server over the framed transport."""
+
+    def __init__(
+        self,
+        router: ModelRouter,
+        serving_cfg: Dict[str, Any],
+        metrics_path: Optional[str] = None,
+    ):
+        cfg = dict(serving_cfg or {})
+        recv_timeout = float(cfg.get("recv_timeout", 0.0)) or None
+        # reply bursts ARE the product here: a pipelining client draining a
+        # whole batch's replies momentarily outruns its socket, and the
+        # hub's default 64-deep send queue would reap it as wedged.  Size
+        # the fault boundary to the engine queue bound instead — a peer
+        # that stops reading for THAT long really is gone
+        super().__init__(
+            recv_timeout=recv_timeout,
+            send_queue_size=max(256, int(cfg.get("queue_bound", 1024))),
+        )
+        self.router = router
+        self.port = int(cfg.get("port", 9997))
+        self.bound_port: Optional[int] = None
+        self.watch_interval = float(cfg.get("watch_interval", 0.0))
+        self.stats_interval = float(cfg.get("stats_interval", 30.0))
+        self._default_slo_s = float(cfg.get("slo_ms", 200.0)) / 1000.0
+        self._sheds = cfg.get("shed_policy", "deadline") != "none"
+        self._metrics_path = metrics_path
+        self._sock = None
+        self._threads: List[threading.Thread] = []
+        # cold resolves (disk load + warm compiles, or waiting on another
+        # loader) run here: bounded workers, so a burst of requests for a
+        # non-resident model queues instead of spawning a thread apiece
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._cold_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="serve-cold"
+        )
+        self._stats_lock = threading.Lock()
+        self.requests_in = 0
+        self.replies = 0
+        self.errors: Dict[str, int] = {}
+        self._stats_t0 = time.monotonic()
+        self._stats_served0 = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self) -> "ServingServer":
+        # bind AND listen synchronously: port 0 (tests/bench) resolves
+        # before return, and a client connecting the instant run() returns
+        # must never see a refused connect because the accept thread
+        # hasn't reached its own listen() yet
+        self._sock = open_socket_connection(self.port)
+        self._sock.listen(1024)
+        self.bound_port = self._sock.getsockname()[1]
+        targets = [self._accept_loop, self._dispatch]
+        if self.watch_interval > 0:
+            targets.append(self._watch_loop)
+        if self._metrics_path and self.stats_interval > 0:
+            targets.append(self._metrics_loop)
+        for target in targets:
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._cold_pool.shutdown(wait=False)
+        self.router.stop()
+
+    def _accept_loop(self) -> None:
+        for conn in accept_socket_connections(timeout=0.5, sock=self._sock):
+            if conn is None:
+                if self.shutdown_flag:
+                    break
+                continue
+            self.add_connection(conn)
+
+    # -- request dispatch ----------------------------------------------------
+
+    def _dispatch(self) -> None:
+        while not self.shutdown_flag:
+            try:
+                conn, frame = self.recv(timeout=0.3)
+            except _queue.Empty:
+                continue
+            try:
+                req, data = frame
+            except (TypeError, ValueError):
+                continue  # malformed frame; the codec already vetted types
+            if req == "heartbeat" or req == "__hb__":
+                continue
+            if not isinstance(data, dict):
+                data = {}
+            rid = data.get("rid")
+            try:
+                if req == "infer":
+                    self._handle_infer(conn, data)
+                elif req == "stats":
+                    # stats_record copies + sorts every engine's latency
+                    # reservoir — O(n log n) a polling dashboard must not
+                    # inject into frame intake; the cold pool is idle
+                    # whenever no snapshot is loading
+                    self._cold_pool.submit(self._handle_stats, conn, rid)
+                elif req == "swap":
+                    # warm-up compiles take seconds: never on this thread —
+                    # and through the BOUNDED pool, so a client looping swap
+                    # frames queues instead of spawning a warming thread
+                    # (and a racing publish) apiece
+                    self._cold_pool.submit(self._handle_swap, conn, data)
+                else:
+                    self._error(conn, rid, "bad_request",
+                                f"unknown request {req!r}")
+            except Exception as exc:
+                # this is THE dispatch thread: no frame — however malformed
+                # or unlucky — may kill it, or every client hangs forever
+                # while the accept loop keeps admitting new ones
+                self._error(conn, rid, "error",
+                            f"{type(exc).__name__}: {exc}")
+
+    def _handle_infer(self, conn: FramedConnection, data: Dict[str, Any]) -> None:
+        with self._stats_lock:
+            self.requests_in += 1
+        # the SLO clock starts at frame arrival: a cold-routed request that
+        # waits behind a snapshot load must not have its budget re-based
+        # when the pool task finally runs it.  Assigned UNCONDITIONALLY —
+        # a wire-supplied "_arrival" would let a client mint itself an
+        # unshedable (or instantly-expired) deadline
+        data["_arrival"] = time.monotonic()
+        try:
+            # hot path: resident routes resolve + submit inline.  ColdRoute
+            # (disk load + warm compiles ahead) re-dispatches to the bounded
+            # cold pool — the resolve call ITSELF makes the decision, so no
+            # check-then-resolve race can sneak cold work onto this thread
+            self._do_infer(conn, data, allow_cold=False)
+        except ColdRoute:
+            self._cold_pool.submit(self._infer_cold, conn, data)
+
+    def _handle_stats(self, conn: FramedConnection, rid) -> None:
+        try:
+            self.send(conn, ("stats", {"rid": rid, "stats": self.stats_record()}))
+        except Exception as exc:  # a pool task must never die silently
+            self._error(conn, rid, "error", f"{type(exc).__name__}: {exc}")
+
+    def _infer_cold(self, conn: FramedConnection, data: Dict[str, Any]) -> None:
+        try:
+            self._do_infer(conn, data)
+        except Exception as exc:  # a pool task must never die silently
+            self._error(conn, data.get("rid"), "error",
+                        f"{type(exc).__name__}: {exc}")
+
+    def _do_infer(self, conn: FramedConnection, data: Dict[str, Any],
+                  allow_cold: bool = True) -> None:
+        rid = data.get("rid")
+        model_id = data.get("model", -1)
+        # the deadline is based at frame ARRIVAL for the default budget
+        # too, not just explicit slo_ms — otherwise a cold-routed request's
+        # wait behind a snapshot load would never count against it (the
+        # engine would stamp a fresh budget at submit time)
+        arrival = data.get("_arrival", time.monotonic())
+        deadline = arrival + self._default_slo_s if self._sheds else None
+        slo_ms = data.get("slo_ms")
+        if slo_ms is not None:
+            try:
+                deadline = arrival + float(slo_ms) / 1000.0
+            except (TypeError, ValueError):
+                self._error(conn, rid, "bad_request",
+                            f"slo_ms={slo_ms!r} is not a number")
+                return
+        for attempt in (0, 1):
+            try:
+                served, route = self.router.resolve(model_id, allow_cold=allow_cold)
+            except ColdRoute:
+                raise
+            except Exception as exc:
+                self._error(conn, rid, getattr(exc, "kind", "bad_request"), str(exc))
+                return
+            fut = route.submit(data.get("obs"), data.get("hidden"), deadline)
+            if (
+                attempt == 0
+                and fut.done()
+                and isinstance(fut.exception(), EngineStopped)
+            ):
+                # raced an eviction's drain between resolve and submit:
+                # re-resolve once — the request must not be dropped by a
+                # retirement it never chose
+                continue
+            break
+        fut.add_done_callback(
+            lambda f, c=conn, r=rid, s=served: self._reply(c, r, s, f)
+        )
+
+    def _reply(self, conn: FramedConnection, rid, served, fut) -> None:
+        exc = fut.exception()
+        if exc is None:
+            with self._stats_lock:
+                self.replies += 1
+            self.send(conn, ("result", {"rid": rid, "model": served, "out": fut.result()}))
+        else:
+            kind = getattr(exc, "kind", None) or (
+                "stopped" if isinstance(exc, EngineStopped) else "error"
+            )
+            self._error(conn, rid, kind, str(exc))
+
+    def _error(self, conn: FramedConnection, rid, kind: str, msg: str) -> None:
+        with self._stats_lock:
+            self.errors[kind] = self.errors.get(kind, 0) + 1
+        self.send(conn, ("error", {"rid": rid, "kind": kind, "msg": msg}))
+
+    def _handle_swap(self, conn: FramedConnection, data: Dict[str, Any]) -> None:
+        rid = (data or {}).get("rid")
+        try:
+            sid = int(data["id"])
+            params = data.get("params")
+            if params is None:
+                params = load_verified_params(
+                    self.router.model_dir, sid, self.router._params_template()
+                )
+            warm_ms = self.router.publish(sid, params)
+            self.send(conn, ("swapped", {"rid": rid, "id": sid, "warm_ms": warm_ms}))
+        except Exception as exc:
+            self._error(conn, rid, "swap_failed", f"{type(exc).__name__}: {exc}")
+
+    # -- checkpoint watcher --------------------------------------------------
+
+    def _watch_loop(self) -> None:
+        while not self.shutdown_flag:
+            time.sleep(self.watch_interval)
+            if self.shutdown_flag:
+                return
+            try:
+                published = self.router.maybe_refresh()
+                if published is not None:
+                    print(f"serving: hot-swapped to verified snapshot {published}")
+            except Exception as exc:
+                # a corrupt manifest mid-write etc. must not kill the watcher
+                print(f"serving: refresh failed: {type(exc).__name__}: {exc}")
+
+    # -- stats / metrics -----------------------------------------------------
+
+    def stats_record(self, advance_window: bool = False) -> Dict[str, Any]:
+        """One metrics.jsonl-shaped record of the serving plane's health.
+        Every key here is registered in utils.metrics.METRIC_KEYS (MET006).
+        qps is over the window since it was last ADVANCED — only the
+        periodic metrics loop advances it, so a dashboard polling wire
+        stats cannot shrink (and thereby noise up) the recorded windows."""
+        rstats = self.router.stats()
+        now = time.monotonic()
+        with self._stats_lock:
+            requests_in = self.requests_in
+            # self.replies is the wire truth: it counts every successful
+            # reply including instant (model 0) and ensemble routes, which
+            # no single engine's requests_served sees
+            replies = self.replies
+            errors = dict(self.errors)
+            dt = max(now - self._stats_t0, 1e-6)
+            served_delta = replies - self._stats_served0
+            if advance_window:
+                self._stats_t0 = now
+                self._stats_served0 = replies
+        record: Dict[str, Any] = {
+            "serve_requests": requests_in,
+            "serve_replies": replies,
+            "serve_shed": rstats["requests_shed"],
+            "serve_deadline_miss": rstats["deadline_misses"],
+            "serve_batches": rstats["batches_served"],
+            "serve_qps": round(served_delta / dt, 2),
+            "serve_p50_ms": rstats["p50_ms"],
+            "serve_p99_ms": rstats["p99_ms"],
+            "serve_hot_swaps": rstats["hot_swaps"],
+            "serve_models": rstats["models"],
+            "serve_snapshot_substituted": rstats["substituted"],
+            "serve_connections": self.connection_count(),
+            "serve_errors": sum(errors.values()),
+        }
+        return record
+
+    def _metrics_loop(self) -> None:
+        while not self.shutdown_flag:
+            time.sleep(self.stats_interval)
+            if self.shutdown_flag:
+                return
+            try:
+                self._write_metrics(self.stats_record(advance_window=True))
+            except Exception as exc:
+                print(f"serving: metrics write failed: {type(exc).__name__}: {exc}")
+
+    def _write_metrics(self, record: Dict[str, Any]) -> None:
+        """Learner._write_metrics discipline: one flushed+fsynced append
+        per record, so readers tolerate at most a truncated tail line."""
+        line = json.dumps(record, default=float) + "\n"
+        with open(self._metrics_path, "a") as f:
+            f.write(line)
+            f.flush()
+            try:
+                os.fsync(f.fileno())
+            except OSError:
+                pass
+
+
+def serve_main(args: Dict[str, Any]) -> None:
+    """`main.py --serve`: standalone serving plane for the configured env.
+
+    Publishes the newest manifest-verified snapshot (fresh-init params
+    when the model dir is empty — a cold dev server still answers), then
+    serves until interrupted.  With ``serving.watch_interval`` > 0 the
+    server follows the training run's checkpoints: every new verified
+    snapshot hot-swaps in with zero dropped requests.
+    """
+    from ..envs import make_env, prepare_env
+
+    train = args["train_args"]
+    env_args = args["env_args"]
+    prepare_env(env_args)
+    env = make_env(env_args)
+    module = env.net()
+    env.reset()
+    template_obs = env.observation(env.players()[0])
+    model_dir = train.get("model_dir", "models")
+
+    router = ModelRouter(
+        module, template_obs, train.get("serving", {}), model_dir=model_dir
+    )
+    newest = 0
+    try:
+        newest = latest_verified_epoch(model_dir)
+    except Exception as exc:
+        print(f"serving: checkpoint scan failed ({exc}); starting fresh")
+    if newest > 0:
+        template = init_variables(module, env)["params"]
+        params = load_verified_params(model_dir, newest, template, pre_verified=True)
+        router.publish(newest, params)
+    else:
+        # cold dev server: fresh-init weights under id 0 — the untrained/
+        # random id, which also keeps the manifest watcher's newer-than-
+        # current check able to pick up training's very first epoch
+        router.publish(0, init_variables(module, env)["params"])
+
+    server = ServingServer(
+        router, train.get("serving", {}), metrics_path=train.get("metrics_path")
+    ).run()
+    print(f"serving: listening on port {server.bound_port} "
+          f"(model {router.latest_id()}, dir {model_dir!r})")
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        print("serving: shutting down")
+    finally:
+        server.shutdown()

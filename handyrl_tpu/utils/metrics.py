@@ -35,6 +35,14 @@ METRIC_KEYS = frozenset({
     "mfu", "device_mean_episode_len",
     # live pipeline / plane topology
     "pipeline", "plane",
+    # serving plane (handyrl_tpu/serving): the learner writes only
+    # serve_snapshot_substituted (LocalModelServer fallback count); the
+    # rest are the ServingServer's periodic health records — exact keys,
+    # not a prefix family, so every new serving stat is reviewed here
+    "serve_snapshot_substituted", "serve_requests", "serve_replies",
+    "serve_shed", "serve_deadline_miss", "serve_batches", "serve_qps",
+    "serve_p50_ms", "serve_p99_ms", "serve_hot_swaps", "serve_models",
+    "serve_connections", "serve_errors",
 })
 # key families written from the *_KEYS tuples (trainer/learner) and the
 # per-epoch plane-health diffs; one prefix registers the family

@@ -4,6 +4,8 @@ Modes:
     --train / -t             standalone training (learner + local actors)
     --train-server / -ts     learner serving remote TCP workers
     --worker / -w            worker machine connecting to a train server
+    --serve / -s             standalone inference serving plane
+                             (continuous batching + hot-swap; docs/serving.md)
     --eval / -e              MODEL_PATH NUM_GAMES NUM_PROCESS
     --eval-server / -es      network battle server
     --eval-client / -ec      network battle client
@@ -57,6 +59,10 @@ if __name__ == "__main__":
         from handyrl_tpu.runtime.server import worker_main
 
         worker_main(args, sys.argv)
+    elif mode in ("--serve", "-s"):
+        from handyrl_tpu.serving import serve_main
+
+        serve_main(args)
     elif mode in ("--eval", "-e"):
         from handyrl_tpu.runtime.evaluation import eval_main
 

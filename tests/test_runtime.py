@@ -18,6 +18,7 @@ from handyrl_tpu.config import normalize_args
 from handyrl_tpu.envs import make_env
 from handyrl_tpu.models import InferenceModel, init_variables
 from handyrl_tpu.runtime import BatchedInferenceEngine, evaluate_mp, exec_match
+from handyrl_tpu.runtime.inference_engine import EngineStopped
 from handyrl_tpu.runtime.learner import Learner
 
 
@@ -50,6 +51,43 @@ def test_inference_engine_matches_direct():
     for r in results:
         np.testing.assert_allclose(r["policy"], direct["policy"], rtol=2e-4, atol=2e-5)
         np.testing.assert_allclose(r["value"], direct["value"], rtol=2e-4, atol=2e-5)
+
+
+def test_engine_submit_stop_race_strands_no_future():
+    """submit racing stop() must leave NO future pending forever: every
+    future a submitter holds resolves — with a result, or EngineStopped.
+    The old post-put re-entrant drain lost this race (a second submit
+    could land in a queue nobody drained again); the lifecycle lock +
+    single-owner drain closes it."""
+    env, model = _tictactoe_model()
+    for _ in range(5):  # the race needs a few spins to be convincing
+        engine = BatchedInferenceEngine(model, max_batch=8, max_wait_ms=0.5).start()
+        futures = []
+        flock = threading.Lock()
+        go = threading.Event()
+
+        def submitter():
+            go.wait()
+            for _ in range(20):
+                fut = engine.submit(env.observation(0))
+                with flock:
+                    futures.append(fut)
+
+        threads = [threading.Thread(target=submitter) for _ in range(4)]
+        for t in threads:
+            t.start()
+        go.set()
+        engine.stop()  # fires while submitters are mid-burst
+        for t in threads:
+            t.join(30)
+        with flock:
+            pending = list(futures)
+        for fut in pending:
+            try:
+                out = fut.result(timeout=30)  # hangs here = the old bug
+                assert "policy" in out
+            except EngineStopped:
+                pass
 
 
 def test_exec_match_agents():

@@ -1,0 +1,717 @@
+"""Serving-plane tests (marker: serving): continuous batcher semantics,
+multi-model routing, the zero-drop hot-swap pin, and the warm-bucket
+compile contract.
+
+The hot-swap test is the subsystem's acceptance pin: clients hammer the
+real network server while the router warms + flips a new model, and the
+test proves (a) zero dropped requests — every submitted request resolves
+with a result, (b) the model-id flip is OBSERVED mid-run in the reply
+stream, (c) requests routed to the old id still get the old params.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.config import normalize_args
+from handyrl_tpu.envs import make_env
+from handyrl_tpu.models import InferenceModel, init_variables
+from handyrl_tpu.runtime.inference_engine import BatchedInferenceEngine, EngineStopped
+from handyrl_tpu.serving import (
+    ContinuousBatcher,
+    DeadlineExceeded,
+    ModelRouter,
+    RequestShed,
+    ServingClient,
+    ServingError,
+    ServingServer,
+)
+from handyrl_tpu.utils.sanitizers import RecompileSentinel
+
+pytestmark = pytest.mark.serving
+
+
+SERVING_CFG = {
+    "port": 0,
+    "max_models": 3,
+    "slo_ms": 2000.0,
+    "shed_policy": "none",
+    "max_batch": 8,
+    "max_wait_ms": 1.0,
+    "warm_buckets": [1, 4, 8],
+    "queue_bound": 256,
+    "recv_timeout": 0.0,
+    "watch_interval": 0.0,
+    "stats_interval": 0.0,
+}
+
+
+def _tictactoe():
+    env = make_env({"env": "TicTacToe"})
+    module = env.net()
+    env.reset()
+    obs = env.observation(0)
+    return env, module, obs
+
+
+def _params(module, env, seed):
+    return init_variables(module, env, seed=seed)["params"]
+
+
+def _batcher(module, params, **overrides):
+    import jax
+
+    kwargs = dict(max_batch=8, max_wait_ms=1.0, slo_ms=2000.0,
+                  shed_policy="none", queue_bound=256)
+    kwargs.update(overrides)
+    model = InferenceModel(module, {"params": params})
+    return ContinuousBatcher(model, [jax.devices()[0]], **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# continuous batcher
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_matches_direct():
+    env, module, obs = _tictactoe()
+    params = _params(module, env, 1)
+    direct = InferenceModel(module, {"params": params}).inference(obs)
+    engine = _batcher(module, params).start()
+    futs = [engine.submit(obs) for _ in range(16)]
+    for fut in futs:
+        out = fut.result(timeout=30)
+        np.testing.assert_allclose(out["policy"], direct["policy"], rtol=2e-4, atol=2e-5)
+    assert engine.requests_served == 16
+    assert engine.batches_served >= 1
+    engine.stop()
+
+
+def test_expired_request_frees_its_slot():
+    """Iteration-level scheduling: requests that expire in the queue fail
+    with DeadlineExceeded at gather time WITHOUT occupying a device slot —
+    the live requests behind them all fit one batch."""
+    env, module, obs = _tictactoe()
+    engine = _batcher(module, _params(module, env, 1), max_batch=8)
+    now = time.monotonic()
+    dead = [engine.submit(obs, deadline=now + 0.01) for _ in range(8)]
+    live = [engine.submit(obs, deadline=now + 60.0) for _ in range(8)]
+    time.sleep(0.05)  # let the short deadlines lapse before the engine runs
+    engine.start()
+    for fut in dead:
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30)
+    for fut in live:
+        assert "policy" in fut.result(timeout=30)
+    # 8 expired + 8 live admitted, max_batch 8: the expiries freed their
+    # slots inside ONE gather pass, so the live batch went out whole
+    assert engine.deadline_misses == 8
+    assert engine.requests_served == 8
+    assert engine.batches_served == 1
+    engine.stop()
+
+
+def test_admission_controller_sheds_fast():
+    """Predicted SLO violation fast-fails at submit — no queue collapse."""
+    env, module, obs = _tictactoe()
+    engine = _batcher(module, _params(module, env, 1), shed_policy="deadline",
+                      slo_ms=10.0)
+    # white-box: a measured service rate of 50ms/batch with a batch already
+    # in flight makes a 10ms budget unservable
+    engine._ema_batch_s = 0.05
+    engine._inflight = 1
+    fut = engine.submit(obs)
+    with pytest.raises(RequestShed):
+        fut.result(timeout=5)
+    assert engine.requests_shed == 1
+    assert engine.requests_admitted == 0
+    engine.stop()
+
+
+def test_idle_engine_admits_despite_poisoned_ema():
+    """The estimator recovery valve: a transiently inflated EMA (compile,
+    GC pause) must not freeze admission shut — an idle engine serves, the
+    batch re-samples the EMA, and admission heals."""
+    env, module, obs = _tictactoe()
+    engine = _batcher(module, _params(module, env, 1), shed_policy="deadline",
+                      slo_ms=50.0).start()
+    engine.warm((1,), obs)
+    engine._ema_batch_s = 10.0  # 200x the budget: would shed forever
+    for _ in range(20):  # idle admits keep serving; each batch re-samples
+        assert "policy" in engine.submit(obs).result(timeout=30)
+    assert engine.requests_shed == 0
+    assert engine._ema_batch_s < 1.0  # the EMA healed (0.8-decay per batch)
+    engine.stop()
+
+
+def test_compile_sample_never_feeds_the_ema():
+    """A bucket's first execution is compile-dominated and excluded from
+    the service-time EMA (warm() marks its buckets as already paid)."""
+    env, module, obs = _tictactoe()
+    engine = _batcher(module, _params(module, env, 1)).start()
+    assert engine.submit(obs).result(timeout=60)  # first bucket-1 batch
+    assert engine._ema_batch_s is None            # compile sample dropped
+    assert engine.submit(obs).result(timeout=60)
+    assert engine._ema_batch_s is not None        # steady sample counted
+    assert engine._ema_batch_s < 1.0
+    engine.stop()
+
+
+def test_queue_bound_sheds():
+    env, module, obs = _tictactoe()
+    engine = _batcher(module, _params(module, env, 1), shed_policy="queue",
+                      queue_bound=4)  # not started: the queue only fills
+    futs = [engine.submit(obs) for _ in range(5)]
+    with pytest.raises(RequestShed):
+        futs[-1].result(timeout=5)
+    assert engine.requests_shed == 1
+    engine.stop()
+    for fut in futs[:-1]:  # stop() owns the drain: nothing left pending
+        with pytest.raises(EngineStopped):
+            fut.result(timeout=5)
+
+
+def test_malformed_obs_fails_only_its_own_request():
+    """A bad observation is rejected at submit (bad_request) and can never
+    poison a batch: co-batched valid requests still serve."""
+    env, module, obs = _tictactoe()
+    engine = _batcher(module, _params(module, env, 1),
+                      template_obs=obs).start()
+    bad = engine.submit(np.zeros((2, 2), np.float32))  # wrong spec
+    good = [engine.submit(obs) for _ in range(4)]
+    from handyrl_tpu.serving import BadRequest
+
+    with pytest.raises(BadRequest):
+        bad.result(timeout=10)
+    for fut in good:
+        assert "policy" in fut.result(timeout=30)
+    engine.stop()
+
+
+def test_shed_policy_none_imposes_no_default_deadline():
+    """'none' is drain semantics: a request sitting in the queue far past
+    slo_ms still completes (only explicit per-request deadlines expire)."""
+    env, module, obs = _tictactoe()
+    engine = _batcher(module, _params(module, env, 1),
+                      shed_policy="none", slo_ms=10.0)  # not started yet
+    fut = engine.submit(obs)
+    time.sleep(0.1)  # 10x the slo in the queue
+    engine.start()
+    assert "policy" in fut.result(timeout=30)
+    assert engine.deadline_misses == 0
+    engine.stop()
+
+
+def test_cold_resolve_survives_capacity_one(tmp_path):
+    """max_models=1: resolving an old snapshot must not have its freshly
+    warmed engine retired before the request can submit."""
+    from handyrl_tpu.runtime.checkpoint import save_epoch_snapshot
+
+    env, module, obs = _tictactoe()
+    p1, p5 = _params(module, env, 1), _params(module, env, 5)
+    save_epoch_snapshot(str(tmp_path), 1, p1, {"params": p1, "steps": 0}, 0)
+    router = ModelRouter(module, obs, dict(SERVING_CFG, max_models=1),
+                         model_dir=str(tmp_path))
+    router.publish(5, p5)
+    served, route = router.resolve(1)  # cold: disk load + warm + spawn
+    assert served == 1
+    d1 = InferenceModel(module, {"params": p1}).inference(obs)
+    out = route.submit(obs).result(timeout=30)  # must not be EngineStopped
+    np.testing.assert_allclose(out["policy"], d1["policy"], rtol=2e-4, atol=2e-5)
+    assert router.substituted == 0
+    router.stop()
+
+
+def test_concurrent_cold_resolves_pay_one_load(tmp_path):
+    """A burst of requests for the same non-resident snapshot spawns ONE
+    engine (one disk load, one warm) — the rest wait on the loader."""
+    from handyrl_tpu.runtime.checkpoint import save_epoch_snapshot
+
+    env, module, obs = _tictactoe()
+    p1, p5 = _params(module, env, 1), _params(module, env, 5)
+    save_epoch_snapshot(str(tmp_path), 1, p1, {"params": p1, "steps": 0}, 0)
+    router = ModelRouter(module, obs, SERVING_CFG, model_dir=str(tmp_path))
+    router.publish(5, p5)
+    results = [None] * 8
+
+    def resolve(i):
+        served, route = router.resolve(1)
+        results[i] = (served, route.submit(obs).result(timeout=60))
+
+    threads = [threading.Thread(target=resolve, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    d1 = InferenceModel(module, {"params": p1}).inference(obs)
+    for served, out in results:
+        assert served == 1
+        np.testing.assert_allclose(out["policy"], d1["policy"], rtol=2e-4, atol=2e-5)
+    assert router._spawned == 2  # latest + exactly one cold loader
+    assert router.substituted == 0
+    router.stop()
+
+
+def test_stopped_router_refuses_cleanly(tmp_path):
+    """After stop(), resolve and publish fail with RouteError (never a
+    KeyError into the cleared table, never a re-registered leaked engine)."""
+    from handyrl_tpu.serving import RouteError
+
+    env, module, obs = _tictactoe()
+    router = ModelRouter(module, obs, SERVING_CFG, model_dir=str(tmp_path))
+    p1 = _params(module, env, 1)
+    router.publish(1, p1)
+    router.stop()
+    with pytest.raises(RouteError, match="stopped"):
+        router.resolve(-1)
+    with pytest.raises(RouteError, match="stopped"):
+        router.publish(2, p1)
+    assert router.routes() == []  # the refused publish registered nothing
+
+
+def test_cold_routes_raise_coldroute_when_disallowed(tmp_path):
+    """allow_cold=False is the dispatch thread's contract: anything that
+    would pay a disk load / warm compile raises ColdRoute instead."""
+    from handyrl_tpu.serving.router import ColdRoute
+
+    env, module, obs = _tictactoe()
+    router = ModelRouter(module, obs, SERVING_CFG, model_dir=str(tmp_path))
+    router.publish(5, _params(module, env, 1))
+    for resident in (-1, 5, 99):  # newer-than-latest serves latest: hot
+        assert router.resolve(resident, allow_cold=False)[0] == 5
+    with pytest.raises(ColdRoute):
+        router.resolve(0, allow_cold=False)   # random route not built yet
+    with pytest.raises(ColdRoute):
+        router.resolve(3, allow_cold=False)   # would pay disk load + warm
+    with pytest.raises(ColdRoute):
+        router.resolve([5, 3], allow_cold=False)
+    router.resolve(0)                         # cold-build the random route
+    assert router.resolve(0, allow_cold=False)[0] == 0  # now hot
+    router.stop()
+
+
+def test_fresh_start_watcher_picks_up_first_epoch(tmp_path):
+    """serve_main's cold-start publish (id 0) must not mask training's
+    very first verified checkpoint from the manifest watcher."""
+    from handyrl_tpu.runtime.checkpoint import save_epoch_snapshot
+
+    env, module, obs = _tictactoe()
+    p0, p1 = _params(module, env, 1), _params(module, env, 2)
+    router = ModelRouter(module, obs, SERVING_CFG, model_dir=str(tmp_path))
+    router.publish(0, p0)  # the cold dev server's fresh-init weights
+    assert router.maybe_refresh() is None
+    save_epoch_snapshot(str(tmp_path), 1, p1, {"params": p1, "steps": 0}, 0)
+    assert router.maybe_refresh() == 1
+    assert router.latest_id() == 1
+    router.stop()
+
+
+def test_drain_and_stop_completes_admitted_work():
+    env, module, obs = _tictactoe()
+    engine = _batcher(module, _params(module, env, 1)).start()
+    futs = [engine.submit(obs) for _ in range(24)]
+    assert engine.drain_and_stop(timeout=60.0)
+    for fut in futs:
+        assert "policy" in fut.result(timeout=5)  # nothing dropped
+    with pytest.raises(EngineStopped):
+        engine.submit(obs).result(timeout=5)  # sealed afterwards
+
+
+# ---------------------------------------------------------------------------
+# bucket warm-up: the compile contract (satellite: RecompileSentinel pin)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_compiles_each_bucket_at_most_once():
+    """A mixed-size request storm compiles each power-of-two bucket at most
+    once; an identical second storm compiles NOTHING."""
+    env, module, obs = _tictactoe()
+    model = InferenceModel(module, init_variables(module, env, seed=3))
+    engine = BatchedInferenceEngine(model, max_batch=8, max_wait_ms=5.0).start()
+
+    def storm():
+        futs = []
+        for group in (3, 5, 2, 8, 1, 6):
+            futs += [engine.submit(obs) for _ in range(group)]
+        for fut in futs:
+            fut.result(timeout=60)
+
+    with RecompileSentinel() as first:
+        storm()
+    # buckets are powers of two capped at 8: {1, 2, 4, 8} is every shape
+    # the storm can reach, however the engine groups the submissions
+    assert first.count <= 4, first.report()
+    with RecompileSentinel() as second:
+        storm()
+    second.assert_no_recompiles("warm mixed-size storm")
+    engine.stop()
+
+
+def test_warm_prepays_every_compile():
+    """ContinuousBatcher.warm covers the configured buckets: the post-warm
+    storm (what clients see right after a hot-swap flip) is compile-free."""
+    env, module, obs = _tictactoe()
+    engine = _batcher(module, _params(module, env, 4)).start()
+    engine.warm((1, 2, 4, 8), obs)
+    with RecompileSentinel() as sentinel:
+        futs = [engine.submit(obs) for _ in range(13)]
+        for fut in futs:
+            fut.result(timeout=60)
+    sentinel.assert_no_recompiles("post-warm storm")
+    engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# router: multi-model, ensemble, substitution accounting
+# ---------------------------------------------------------------------------
+
+
+def test_router_routes_by_model_id(tmp_path):
+    env, module, obs = _tictactoe()
+    p1, p2 = _params(module, env, 1), _params(module, env, 2)
+    router = ModelRouter(module, obs, SERVING_CFG, model_dir=str(tmp_path))
+    router.publish(1, p1)
+    router.publish(2, p2)
+    assert router.latest_id() == 2
+    assert router.routes() == [1, 2]
+
+    d1 = InferenceModel(module, {"params": p1}).inference(obs)
+    d2 = InferenceModel(module, {"params": p2}).inference(obs)
+    for mid, want in ((-1, d2), (2, d2), (1, d1), (99, d2)):
+        served, route = router.resolve(mid)
+        out = route.submit(obs).result(timeout=30)
+        np.testing.assert_allclose(out["policy"], want["policy"], rtol=2e-4, atol=2e-5)
+        assert served == (2 if mid != 1 else 1)
+    router.stop()
+
+
+def test_router_ensemble_mean_pools(tmp_path):
+    env, module, obs = _tictactoe()
+    p1, p2 = _params(module, env, 1), _params(module, env, 2)
+    router = ModelRouter(module, obs, SERVING_CFG, model_dir=str(tmp_path))
+    router.publish(1, p1)
+    router.publish(2, p2)
+    d1 = InferenceModel(module, {"params": p1}).inference(obs)
+    d2 = InferenceModel(module, {"params": p2}).inference(obs)
+    served, route = router.resolve([1, 2])
+    out = route.submit(obs).result(timeout=30)
+    assert served == (1, 2)
+    np.testing.assert_allclose(
+        out["policy"],
+        (np.asarray(d1["policy"], np.float32) + np.asarray(d2["policy"], np.float32)) / 2.0,
+        rtol=2e-4, atol=2e-5,
+    )
+    router.stop()
+
+
+def test_ensemble_refuses_hidden_state(tmp_path):
+    """An ensemble route cannot thread per-member recurrent state: a
+    hidden-carrying request is refused loudly, never silently served from
+    initial state."""
+    from handyrl_tpu.serving import BadRequest
+
+    env, module, obs = _tictactoe()
+    router = ModelRouter(module, obs, SERVING_CFG, model_dir=str(tmp_path))
+    router.publish(1, _params(module, env, 1))
+    router.publish(2, _params(module, env, 2))
+    _served, route = router.resolve([1, 2])
+    with pytest.raises(BadRequest, match="recurrent"):
+        route.submit(obs, hidden={"h": np.zeros(4)}).result(timeout=10)
+    router.stop()
+
+
+def test_router_substitution_is_counted(tmp_path):
+    """A requested snapshot that cannot be verified serves latest AND
+    increments the substitution counter — never a silent swap."""
+    env, module, obs = _tictactoe()
+    router = ModelRouter(module, obs, SERVING_CFG, model_dir=str(tmp_path))
+    router.publish(5, _params(module, env, 1))
+    served, _route = router.resolve(3)  # 3.ckpt does not exist
+    assert served == 5
+    assert router.substituted == 1
+    assert router.stats()["substituted"] == 1
+    router.stop()
+
+
+def test_local_model_server_substitution_is_counted(tmp_path):
+    """Satellite pin: LocalModelServer's substitute-latest fallback is a
+    visible cumulative counter, surfaced as serve_snapshot_substituted."""
+    from handyrl_tpu.runtime.worker import LocalModelServer
+
+    env = make_env({"env": "TicTacToe"})
+    module = env.net()
+    server = LocalModelServer(
+        module, env, {"model_dir": str(tmp_path), "inference_batch_size": 8}
+    )
+    server.publish(5, init_variables(module, env, seed=1)["params"])
+    assert server.substituted_snapshots == 0
+    client = server.get(3)  # snapshot 3 was never written: substitutes latest
+    assert client is not None
+    assert server.substituted_snapshots == 1
+    server.get(2)
+    assert server.substituted_snapshots == 2
+    server.engine.stop()
+
+
+def test_router_eviction_drains_not_drops(tmp_path):
+    env, module, obs = _tictactoe()
+    cfg = dict(SERVING_CFG, max_models=2)
+    router = ModelRouter(module, obs, cfg, model_dir=str(tmp_path))
+    engines = {}
+    for mid in (1, 2, 3):
+        router.publish(mid, _params(module, env, mid))
+        if mid == 1:  # traffic the eviction must not erase from the books
+            assert "policy" in router.resolve(1)[1].submit(obs).result(timeout=30)
+        engines[mid] = router._engines.get(mid)
+    # capacity 2: model 1 (LRU non-latest) was evicted, latest pinned
+    assert router.latest_id() == 3
+    assert 3 in router.routes() and len(router.routes()) == 2
+    for t in list(router._retiring):
+        t.join(30)
+    evicted = engines[1]
+    assert evicted is not None and evicted._stop.is_set()
+    # cumulative stats stay monotonic across the eviction: the retired
+    # engine's served count folded into the router totals
+    assert router.stats()["requests_served"] >= 1
+    router.stop()
+
+
+# ---------------------------------------------------------------------------
+# the network server + the hot-swap acceptance pin
+# ---------------------------------------------------------------------------
+
+
+def _start_server(module, obs, tmp_path, **cfg_overrides):
+    cfg = dict(SERVING_CFG, **cfg_overrides)
+    router = ModelRouter(module, obs, cfg, model_dir=str(tmp_path))
+    server = ServingServer(router, cfg).run()
+    return router, server
+
+
+def test_server_roundtrip_and_stats(tmp_path):
+    env, module, obs = _tictactoe()
+    p1 = _params(module, env, 1)
+    router, server = _start_server(module, obs, tmp_path)
+    router.publish(1, p1)
+    client = ServingClient("127.0.0.1", server.bound_port)
+    try:
+        direct = InferenceModel(module, {"params": p1}).inference(obs)
+        reply = client.infer(obs)
+        assert reply["model"] == 1
+        np.testing.assert_allclose(
+            reply["out"]["policy"], direct["policy"], rtol=2e-4, atol=2e-5
+        )
+        ens = client.infer(obs, model=[1, 1])
+        assert tuple(ens["model"]) == (1, 1)
+        rnd = client.infer(obs, model=0)
+        assert rnd["model"] == 0
+        assert float(np.abs(np.asarray(rnd["out"]["policy"])).sum()) == 0.0
+        stats = client.stats()
+        assert stats["serve_replies"] >= 3
+        assert stats["serve_models"] == 1
+        assert stats["serve_p50_ms"] is not None
+        assert stats["serve_snapshot_substituted"] == 0
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_server_reports_shed_over_the_wire(tmp_path):
+    env, module, obs = _tictactoe()
+    router, server = _start_server(
+        module, obs, tmp_path, shed_policy="deadline", slo_ms=50.0
+    )
+    router.publish(1, _params(module, env, 1))
+    # force an unservable prediction on the one resident engine
+    engine = router._engines[1]
+    engine._ema_batch_s = 10.0
+    engine._inflight = 1
+    client = ServingClient("127.0.0.1", server.bound_port)
+    try:
+        with pytest.raises(ServingError) as err:
+            client.infer(obs, slo_ms=5.0)
+        assert err.value.kind in ("shed", "deadline")
+        assert client.stats()["serve_shed"] >= 1
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_hot_swap_under_load_drops_nothing(tmp_path):
+    """THE acceptance pin: hammer the server across a hot-swap; every
+    request is answered, the flip is observed mid-run, nothing drops."""
+    env, module, obs = _tictactoe()
+    p1, p2 = _params(module, env, 1), _params(module, env, 2)
+    router, server = _start_server(module, obs, tmp_path, shed_policy="none")
+    router.publish(1, p1)
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    served_ids = []
+    submitted = [0]
+    failures = []
+
+    def hammer():
+        client = ServingClient("127.0.0.1", server.bound_port)
+        try:
+            while not stop.is_set():
+                with lock:
+                    submitted[0] += 1
+                try:
+                    reply = client.infer(obs, timeout=60)
+                    with lock:
+                        served_ids.append(reply["model"])
+                except Exception as exc:  # any failure = a dropped request
+                    with lock:
+                        failures.append(repr(exc))
+                    return
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=hammer, daemon=True) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)  # steady-state load on model 1
+
+    admin = ServingClient("127.0.0.1", server.bound_port)
+    swap = admin.swap(2, params=p2)
+    assert swap["id"] == 2
+    assert swap["warm_ms"] > 0  # the standby engine really warmed pre-flip
+
+    time.sleep(0.4)  # steady-state load on model 2
+    stop.set()
+    for t in threads:
+        t.join(30)
+    admin.close()
+    server.shutdown()
+
+    assert not failures, failures[:5]
+    assert len(served_ids) == submitted[0]  # zero dropped requests
+    assert set(served_ids) == {1, 2}        # the flip observed mid-run
+    # load started well before the swap and ran well past it: the stream
+    # begins on the old model and ends on the new one
+    assert served_ids[0] == 1 and served_ids[-1] == 2
+
+
+def test_cold_model_served_over_the_wire(tmp_path):
+    """A request for a non-resident snapshot takes the cold pool path
+    (ColdRoute) and still serves — off the dispatch thread."""
+    from handyrl_tpu.runtime.checkpoint import save_epoch_snapshot
+
+    env, module, obs = _tictactoe()
+    p1, p5 = _params(module, env, 1), _params(module, env, 5)
+    save_epoch_snapshot(str(tmp_path), 1, p1, {"params": p1, "steps": 0}, 0)
+    router, server = _start_server(module, obs, tmp_path)
+    router.publish(5, p5)
+    client = ServingClient("127.0.0.1", server.bound_port)
+    try:
+        reply = client.infer(obs, model=1, timeout=120)
+        assert reply["model"] == 1
+        d1 = InferenceModel(module, {"params": p1}).inference(obs)
+        np.testing.assert_allclose(
+            reply["out"]["policy"], d1["policy"], rtol=2e-4, atol=2e-5
+        )
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_malformed_frames_do_not_kill_the_dispatch_thread(tmp_path):
+    """One bad frame (None payload, junk slo_ms, unknown request, bad obs)
+    must error THAT request only — the server keeps serving everyone."""
+    from handyrl_tpu.runtime.connection import connect_socket_connection
+
+    env, module, obs = _tictactoe()
+    router, server = _start_server(module, obs, tmp_path)
+    router.publish(1, _params(module, env, 1))
+    raw = connect_socket_connection("127.0.0.1", server.bound_port)
+    try:
+        raw.send(("infer", None))                      # payload not a dict
+        raw.send(("infer", {"rid": 2, "obs": obs, "slo_ms": "soon"}))
+        raw.send(("infer", {"rid": 3, "obs": None}))   # spec-violating obs
+        raw.send(("no_such_request", {"rid": 4}))
+        kinds = {}
+        for _ in range(4):
+            kind, data = raw.recv(timeout=30)
+            assert kind == "error"
+            kinds[data.get("rid")] = data["kind"]
+        assert kinds[2] == "bad_request"               # junk slo_ms
+        assert kinds[3] == "bad_request"               # obs spec gate
+        assert kinds[4] == "bad_request"               # unknown request
+    finally:
+        raw.close()
+    # the dispatch thread survived all of it: a clean client still serves
+    client = ServingClient("127.0.0.1", server.bound_port)
+    try:
+        assert client.infer(obs, timeout=30)["model"] == 1
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_swap_from_disk_verified(tmp_path):
+    """swap with no inline params loads the digest-verified snapshot."""
+    from handyrl_tpu.runtime.checkpoint import save_epoch_snapshot
+
+    env, module, obs = _tictactoe()
+    p1, p2 = _params(module, env, 1), _params(module, env, 2)
+    save_epoch_snapshot(str(tmp_path), 7, p2, {"params": p2, "steps": 0}, 0)
+    router, server = _start_server(module, obs, tmp_path)
+    router.publish(1, p1)
+    client = ServingClient("127.0.0.1", server.bound_port)
+    try:
+        swap = client.swap(7)
+        assert swap["id"] == 7
+        d2 = InferenceModel(module, {"params": p2}).inference(obs)
+        reply = client.infer(obs)
+        assert reply["model"] == 7
+        np.testing.assert_allclose(
+            reply["out"]["policy"], d2["policy"], rtol=2e-4, atol=2e-5
+        )
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_watcher_hot_swaps_on_new_verified_snapshot(tmp_path):
+    from handyrl_tpu.runtime.checkpoint import save_epoch_snapshot
+
+    env, module, obs = _tictactoe()
+    p1, p2 = _params(module, env, 1), _params(module, env, 2)
+    router, server = _start_server(module, obs, tmp_path, watch_interval=0.1)
+    router.publish(1, p1)
+    save_epoch_snapshot(str(tmp_path), 9, p2, {"params": p2, "steps": 0}, 0)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and router.latest_id() != 9:
+        time.sleep(0.05)
+    assert router.latest_id() == 9
+    server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+
+def _cfg(**serving):
+    return {"env_args": {"env": "TicTacToe"}, "train_args": {"serving": serving}}
+
+
+def test_serving_config_validation():
+    assert normalize_args(_cfg())  # defaults valid
+    with pytest.raises(ValueError, match="shed_policy"):
+        normalize_args(_cfg(shed_policy="panic"))
+    with pytest.raises(ValueError, match="warm_buckets"):
+        normalize_args(_cfg(warm_buckets=[3]))
+    with pytest.raises(ValueError, match="exceeds"):
+        normalize_args(_cfg(warm_buckets=[128], max_batch=64))
+    with pytest.raises(ValueError, match="slo_ms"):
+        normalize_args(_cfg(slo_ms=0))
+    with pytest.raises(ValueError, match="max_models"):
+        normalize_args(_cfg(max_models=0))
+    with pytest.raises(ValueError, match="port"):
+        normalize_args(_cfg(port=70000))
+    with pytest.raises(ValueError, match="watch_interval"):
+        normalize_args(_cfg(watch_interval=-1))

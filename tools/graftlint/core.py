@@ -214,6 +214,9 @@ class LintConfig:
         "handyrl_tpu/runtime/learner.py",
         "handyrl_tpu/runtime/device_*.py",
         "handyrl_tpu/parallel/train_step.py",
+        # the serving plane's request loop is a latency hot path: one
+        # stray per-batch host sync is a p99 regression on every model
+        "handyrl_tpu/serving/*.py",
     )
     # functions (bare names) that are drain/teardown/construction paths —
     # host syncs there are the POINT, not a leak
@@ -236,6 +239,10 @@ class LintConfig:
         "handyrl_tpu/runtime/plane.py",
         "handyrl_tpu/runtime/shm_batch.py",
         "handyrl_tpu/parallel/train_step.py",
+        # per-model serving engines share chips with each other (and, co-
+        # located, with a training plane): every engine dispatch must hold
+        # its explicit device scope
+        "handyrl_tpu/serving/*.py",
     )
     dispatch_wrapper: str = "dispatch_serialized"
 
@@ -244,7 +251,7 @@ class LintConfig:
     cfg005_docs: str = "docs/parameters.md"
     # dict-valued defaults whose CHILDREN are the knobs (worker.entry_port);
     # every other dict-valued default (mesh, ...) is one knob
-    cfg005_nested: Tuple[str, ...] = ("worker", "distributed", "eval")
+    cfg005_nested: Tuple[str, ...] = ("worker", "distributed", "eval", "serving")
     # documented spellings that are intentionally not defaults (aliases
     # normalized away before validation)
     cfg005_doc_aliases: Tuple[str, ...] = ("attn_mode",)
@@ -254,6 +261,7 @@ class LintConfig:
     met006_writers: Tuple[str, ...] = (
         "handyrl_tpu/runtime/learner.py",
         "handyrl_tpu/runtime/trainer.py",
+        "handyrl_tpu/serving/server.py",
     )
     # module-level *_KEYS tuples that feed metrics keys, with the prefix
     # they are written under
