@@ -1794,11 +1794,136 @@ def _serving_bench(duration: float):
     return out
 
 
+# league-stage geometry: the training leg is EPOCH-bounded (the gate
+# needs whole epoch boundaries, not a wall-clock window)
+LEAGUE_EPOCHS = 3 if QUICK else 5
+LEAGUE_UPDATE_EPISODES = 16 if QUICK else 24
+
+
+def _league_bench(duration: float):
+    """League plane + autovec stage (docs/league.md §Bench + CI).
+
+    Leg A — the twin-less env compiler's cost, apples to apples: device
+    self-play throughput of autovec-lifted TicTacToe vs the hand-written
+    VectorTicTacToe (same game, same net, same device set — the per-chip
+    frac isolates the lift), judged at ROADMAP item 4's >= 0.5 bar; plus
+    lifted ConnectFour absolute throughput (an env with NO hand twin).
+
+    Leg B — a small end-to-end league run (TicTacToe, anchor-seeded):
+    PFSP matchmaking, payoff coverage, promotion gate, Elo spread — the
+    same path tests/test_league.py::test_league_end_to_end pins, here
+    with its realized numbers committed to the bench record.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from examples.connect_four import ConnectFourRules
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.envs.autovec import autovectorize
+    from handyrl_tpu.envs.tictactoe import TicTacToeRules
+    from handyrl_tpu.envs.vector_tictactoe import VectorTicTacToe
+    from handyrl_tpu.models import init_variables
+    from handyrl_tpu.runtime.device_rollout import build_selfplay_fn
+
+    n_games = 2048 if jax.default_backend() == "tpu" else 512
+
+    def selfplay_rate(env_name, venv, window):
+        env = make_env({"env": env_name})
+        module = env.net()
+        params = init_variables(module, env)["params"]
+        fn = build_selfplay_fn(venv, module, n_games)
+        holder = {"key": jax.random.PRNGKey(0)}
+
+        def call():
+            holder["key"], sub = jax.random.split(holder["key"])
+            cols = fn(params, sub)
+            holder["last"] = cols
+            return cols["alive"]
+
+        calls_per_sec = _timed_loop(call, window)
+        alive = float(jax.device_get(holder["last"]["alive"]).sum())
+        return calls_per_sec * alive
+
+    window = max(duration / 4, 2.0)
+    hand = selfplay_rate("TicTacToe", VectorTicTacToe, window)
+    auto = selfplay_rate("TicTacToe", autovectorize(TicTacToeRules), window)
+    c4 = selfplay_rate("ConnectFour", autovectorize(ConnectFourRules), window)
+    out = {
+        "twin_steps_per_sec": hand,
+        "autovec_steps_per_sec": auto,
+        # identical device sets on both sides, so the ratio IS per-chip
+        "autovec_per_chip_frac": auto / max(hand, 1e-9),
+        "autovec_target_met": auto / max(hand, 1e-9) >= 0.5,
+        "connectfour_autovec_steps_per_sec": c4,
+        "n_games": n_games,
+    }
+
+    # -- leg B: end-to-end league run ---------------------------------------
+    from handyrl_tpu.config import normalize_args
+    from handyrl_tpu.league.learner import LeagueLearner
+
+    run_dir = tempfile.mkdtemp(prefix="bench_league_")
+    try:
+        cfg = normalize_args({
+            "env_args": {"env": "TicTacToe"},
+            "train_args": {
+                "batch_size": 8,
+                "forward_steps": 4,
+                "update_episodes": LEAGUE_UPDATE_EPISODES,
+                "minimum_episodes": 12,
+                "maximum_episodes": 500,
+                "num_batchers": 0,
+                "batch_pipeline": "thread",
+                "epochs": LEAGUE_EPOCHS,
+                "eval_rate": 0.0,
+                "worker": {"num_parallel": 2},
+                "metrics_path": os.path.join(run_dir, "metrics.jsonl"),
+                "model_dir": os.path.join(run_dir, "models"),
+                # the bar below random-vs-random wp: the bench commits the
+                # MECHANICS' numbers (coverage, spread, promotions) —
+                # candidate strength vs a real bar is a soak concern
+                "league": {"promote_winrate": 0.4, "promote_games": 3,
+                           "selfplay_rate": 0.15},
+            },
+        })
+        t0 = time.perf_counter()
+        learner = LeagueLearner(cfg)
+        rc = learner.run()
+        out["run_seconds"] = time.perf_counter() - t0
+        if rc != 0:
+            raise RuntimeError(f"league run exited {rc}")
+        from handyrl_tpu.league import ANCHOR, CANDIDATE
+        from handyrl_tpu.utils.metrics import read_metrics
+
+        payoff = learner.league.payoff
+        pool = [m.name for m in learner.league.opponent_pool()]
+        rated = payoff.elo(pool + [CANDIDATE], anchor=ANCHOR)
+        out["population"] = len(learner.league.members)
+        out["promotions"] = learner.league.promotions
+        out["matches"] = payoff.matches
+        # a promotion hands the candidate's books to the frozen member, so
+        # the FINAL row can legitimately read 0; the coverage story is the
+        # best fill any generation reached (1.0 = some gate saw every pair)
+        records = read_metrics(cfg["train_args"]["metrics_path"])
+        out["payoff_coverage"] = max(
+            (r.get("league_payoff_coverage") or 0.0 for r in records),
+            default=0.0,
+        )
+        out["elo_spread"] = (
+            max(rated.values()) - min(rated.values()) if len(rated) >= 2 else None
+        )
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+    return out
+
+
 KNOWN_STAGES = (
     "tictactoe", "device-selfplay", "geese-device-selfplay", "geese-gen",
     "geese-train", "northstar", "northstar2", "northstar3", "northstar4",
     "geese-bf16", "geister", "geister-device-selfplay", "geister-devreplay",
-    "serving", "transformer", "transformer_long", "flash",
+    "serving", "league", "transformer", "transformer_long", "flash",
 )
 # stages that consume another stage's result (main() gates them on it)
 STAGE_DEPS = {
@@ -2310,6 +2435,42 @@ def main() -> None:
             )
 
     _run_stage(result, "serving", stage_serving)
+
+    # 3f. league plane + the twin-less env compiler (ROADMAP item 4): the
+    # autovec-vs-hand-twin per-chip frac at the >= 0.5 bar, lifted
+    # ConnectFour with NO hand twin, and a small end-to-end league run's
+    # payoff coverage / Elo spread / promotions
+    def stage_league():
+        lg = _league_bench(T_TRAIN)
+        result["extra"]["league_twin_steps_per_sec"] = _sig(
+            lg["twin_steps_per_sec"], 4
+        )
+        result["extra"]["league_autovec_steps_per_sec"] = _sig(
+            lg["autovec_steps_per_sec"], 4
+        )
+        result["extra"]["league_autovec_per_chip_frac"] = _sig(
+            lg["autovec_per_chip_frac"]
+        )
+        result["extra"]["league_autovec_target_met"] = lg["autovec_target_met"]
+        result["extra"]["league_connectfour_autovec_steps_per_sec"] = _sig(
+            lg["connectfour_autovec_steps_per_sec"], 4
+        )
+        result["extra"]["league_population"] = lg["population"]
+        result["extra"]["league_promotions"] = lg["promotions"]
+        result["extra"]["league_matches"] = lg["matches"]
+        result["extra"]["league_payoff_coverage"] = round(
+            lg["payoff_coverage"], 4
+        )
+        if lg["elo_spread"] is not None:
+            result["extra"]["league_elo_spread"] = _sig(lg["elo_spread"], 4)
+        result["extra"]["league_run_seconds"] = _sig(lg["run_seconds"], 4)
+        if not lg["autovec_target_met"]:
+            result["error"] = (result["error"] or "") + (
+                " league: autovec per-chip frac %.3f below the 0.5 bar"
+                % lg["autovec_per_chip_frac"]
+            )
+
+    _run_stage(result, "league", stage_league)
 
     # 4c. turn-mode device-resident replay: Geister DRC trained straight
     # from device rings (all-player burn-in windows, runtime/device_replay

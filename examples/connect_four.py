@@ -1,16 +1,20 @@
-"""Connect Four — a complete custom environment outside the built-in
-registry, loaded by dotted path (docs/custom_environment.md):
+"""Connect Four — a complete custom environment, registered in the env
+zoo as ``env: ConnectFour`` (envs/__init__.py) and also loadable by
+dotted path (docs/custom_environment.md):
 
     env_args:
-      env: 'examples.connect_four'
+      env: 'ConnectFour'            # or 'examples.connect_four'
 
 Demonstrates the user extension contract end-to-end: the 17-method game
 interface (reference environment.py:41-145), delta-sync for network
-battle mode, a rule-based opponent, a bespoke net hookup, AND a device
-twin (``VectorConnectFour`` below) — the worked example of writing the
-batched pure-jnp rules that unlock fully on-device self-play
-(``device_rollout_games``) for a custom game.  Lock-step rules parity
-with the host env is asserted by tests/test_device_rollout.py.
+battle mode, a rule-based opponent, a bespoke net hookup, AND the
+**twin-less device path**: instead of a hand-written ``vector_*`` twin,
+the game rules are written ONCE as pure single-game numpy functions
+(``ConnectFourRules``) and ``envs/autovec.py`` lifts them into the
+batched jnp vector env that unlocks fully on-device self-play
+(``device_rollout_games``) and league training.  Step-parity of the lift
+is asserted by tests/test_autovec.py, and rules parity with the host env
+by tests/test_device_rollout.py.
 
 Run a random self-play smoke loop (like the built-in envs):
 
@@ -148,28 +152,29 @@ class Environment(BaseEnvironment):
 
     @staticmethod
     def vector_env():
-        """Device twin for on-device self-play (`device_rollout_games`)."""
-        return VectorConnectFour
+        """Device twin for on-device self-play (``device_rollout_games``)
+        — autovectorized from ``ConnectFourRules``, no hand-written
+        ``vector_connect_four`` (envs/autovec.py; lifts are memoized)."""
+        from handyrl_tpu.envs.autovec import autovectorize
+
+        return autovectorize(ConnectFourRules)
 
     def __str__(self) -> str:
         rows = ["".join(".XO"[v] for v in row) for row in self.board]
         return "\n".join(rows)
 
 
-class VectorConnectFour:
-    """Batched pure-jnp Connect Four — the device twin of ``Environment``.
+class ConnectFourRules:
+    """Pure single-game numpy rules — the autovec source of truth.
 
-    The worked example of the VectorTicTacToe-style episodic contract
-    (handyrl_tpu/envs/vector_tictactoe.py): strict turn alternation lets
-    the step index be a static Python int, every transition is a total
-    function (finished games pass through unchanged), and the win test is
-    branch-free shifted-slice sums instead of the host env's scan loops.
-    ``runtime/device_rollout.make_device_rollout`` picks the episodic
-    driver automatically (no streaming ``record`` hook).
+    Same rules as ``Environment`` (pinned by tests), written to the
+    autovec liftability contract (envs/autovec.py): pure functions,
+    out-of-place array updates, no value-dependent python control flow,
+    fixed shapes/dtypes.  Strict turn alternation makes the step index a
+    static python int, so turn math is ordinary python.
 
-    State (per game, batch-leading):
-        cells  (B, 6, 7) int8   0 empty / +1 first player / -1 second
-        winner (B,)      int8   0 none / +-1
+    State (one game): ``board`` (6, 7) int8 (0 empty / +1 first player /
+    -1 second), ``winner`` () int8 (0 none / +-1).
     """
 
     num_actions = COLS
@@ -177,98 +182,82 @@ class VectorConnectFour:
     num_players = 2
 
     @staticmethod
-    def init(n_games: int):
-        import jax.numpy as jnp
-
-        return {
-            "cells": jnp.zeros((n_games, ROWS, COLS), jnp.int8),
-            "winner": jnp.zeros((n_games,), jnp.int8),
-        }
-
-    @staticmethod
-    def color(step: int) -> int:
+    def _color(step: int) -> int:
         return 1 if step % 2 == 0 else -1
 
     @staticmethod
-    def turn_player(step: int) -> int:
-        return step % 2
+    def init():
+        return {
+            "board": np.zeros((ROWS, COLS), np.int8),
+            "winner": np.zeros((), np.int8),
+        }
 
     @staticmethod
     def observation(state, step: int):
-        """(B, 3, 6, 7) turn-player planes, identical to the host
-        ``observation()``: own stones, opponent stones, side-to-move."""
-        import jax.numpy as jnp
-
-        me = VectorConnectFour.color(step)
-        cells = state["cells"]
-        B = cells.shape[0]
-        return jnp.stack(
+        """(3, 6, 7) turn-player planes, identical to the host
+        ``observation()`` at acting time: own stones, opponent stones,
+        side-to-move (always mine when acting)."""
+        me = ConnectFourRules._color(step)
+        board = state["board"]
+        return np.stack(
             [
-                (cells == me).astype(jnp.float32),
-                (cells == -me).astype(jnp.float32),
-                jnp.ones((B, ROWS, COLS), jnp.float32),  # acting => my move
-            ],
-            axis=1,
+                (board == me).astype(np.float32),
+                (board == -me).astype(np.float32),
+                np.ones((ROWS, COLS), np.float32),
+            ]
         )
 
     @staticmethod
     def legal_mask(state):
-        """(B, 7) bool — columns whose top cell is empty."""
-        return state["cells"][:, 0, :] == 0
+        """(7,) bool — columns whose top cell is empty."""
+        return state["board"][0, :] == 0
 
     @staticmethod
     def terminal(state, step: int):
-        return (state["winner"] != 0) | (step >= VectorConnectFour.max_steps)
+        return (state["winner"] != 0) | (step >= ROWS * COLS)
 
     @staticmethod
     def _connects(stones):
-        """(B,) bool — any 4-in-a-row in the (B, 6, 7) bool plane, as sums
-        of four shifted slices per direction (static shapes, no loops)."""
-        s = stones.astype("int8")
-        h = s[:, :, :-3] + s[:, :, 1:-2] + s[:, :, 2:-1] + s[:, :, 3:]
-        v = s[:, :-3, :] + s[:, 1:-2, :] + s[:, 2:-1, :] + s[:, 3:, :]
-        d = s[:, :-3, :-3] + s[:, 1:-2, 1:-2] + s[:, 2:-1, 2:-1] + s[:, 3:, 3:]
-        u = s[:, 3:, :-3] + s[:, 2:-1, 1:-2] + s[:, 1:-2, 2:-1] + s[:, :-3, 3:]
+        """Any 4-in-a-row in a (6, 7) bool plane, as sums of four shifted
+        slices per direction (static shapes, no loops)."""
+        s = stones.astype(np.int8)
+        h = s[:, :-3] + s[:, 1:-2] + s[:, 2:-1] + s[:, 3:]
+        v = s[:-3, :] + s[1:-2, :] + s[2:-1, :] + s[3:, :]
+        d = s[:-3, :-3] + s[1:-2, 1:-2] + s[2:-1, 2:-1] + s[3:, 3:]
+        u = s[3:, :-3] + s[2:-1, 1:-2] + s[1:-2, 2:-1] + s[:-3, 3:]
         return (
-            (h == CONNECT).any(axis=(1, 2))
-            | (v == CONNECT).any(axis=(1, 2))
-            | (d == CONNECT).any(axis=(1, 2))
-            | (u == CONNECT).any(axis=(1, 2))
+            (h == CONNECT).any()
+            | (v == CONNECT).any()
+            | (d == CONNECT).any()
+            | (u == CONNECT).any()
         )
 
     @staticmethod
-    def apply(state, actions, step: int):
-        """Gravity-drop ``actions`` (B,) for the step's color in every
-        live game; finished games pass through unchanged."""
-        import jax
-        import jax.numpy as jnp
-
-        me = VectorConnectFour.color(step)
-        cells, winner = state["cells"], state["winner"]
-        live = ~VectorConnectFour.terminal(state, step)
-
-        # landing row = (empties in the chosen column) - 1; a full column
-        # (illegal, excluded by legal_mask) gives -1, which one_hot maps
-        # to an all-zero row mask — a safe no-op, keeping apply total
-        empties = (cells == 0).sum(axis=1)                       # (B, 7)
-        row = jnp.take_along_axis(empties, actions[:, None].astype(jnp.int32), 1)[:, 0] - 1
-        cell = (
-            jax.nn.one_hot(row, ROWS, dtype=jnp.int8)[:, :, None]
-            * jax.nn.one_hot(actions, COLS, dtype=jnp.int8)[:, None, :]
-        ) * live[:, None, None].astype(jnp.int8)                 # (B, 6, 7)
-        cells = jnp.where(cell > 0, jnp.int8(me), cells)
-
-        won = VectorConnectFour._connects(cells == me) & live
-        winner = jnp.where(won, jnp.int8(me), winner)
-        return {"cells": cells, "winner": winner}
+    def apply(state, action, step: int):
+        """Gravity-drop ``action`` for the step's color.  Called on live
+        games only (the autovec totality wrapper discards its output for
+        finished lanes); a full column — illegal, excluded by legal_mask
+        — gives row -1, which the equality masks below match NOWHERE, so
+        the drop is a safe no-op (do NOT rewrite this as integer indexing
+        ``board[row, action]``: -1 would then really wrap to the bottom
+        row on the host-numpy execution path)."""
+        me = ConnectFourRules._color(step)
+        board = state["board"]
+        empties = (board == 0).sum(axis=0)                    # (7,)
+        row = empties[action] - 1
+        cell = (np.arange(ROWS)[:, None] == row) & (
+            np.arange(COLS)[None, :] == action
+        )
+        board = np.where(cell, np.int8(me), board)
+        won = ConnectFourRules._connects(board == me)
+        winner = np.where(won, np.int8(me), state["winner"]).astype(np.int8)
+        return {"board": board, "winner": winner}
 
     @staticmethod
     def outcome(state):
-        """(B, 2) float32 per-player scores, host ``outcome()`` order."""
-        import jax.numpy as jnp
-
-        w = state["winner"].astype(jnp.float32)
-        return jnp.stack([w, -w], axis=1)
+        """(2,) float32 per-player scores, host ``outcome()`` order."""
+        w = state["winner"].astype(np.float32)
+        return np.stack([w, -w])
 
 
 if __name__ == "__main__":

@@ -213,6 +213,37 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
         # by the standalone server (0 = off)
         "stats_interval": 30.0,
     },
+    # --- league training plane (docs/league.md) -------------------------
+    # `main.py --league` (handyrl_tpu/league): population-based training —
+    # a persistent League of frozen snapshots + anchors backed by the
+    # checkpoint manifest, PFSP matchmaking over a per-ordered-pair payoff
+    # ledger, ModelRouter-resident opponent engines, and a gated promotion
+    # that freezes the candidate into the population
+    "league": {
+        # opponent sampling over the frozen population (AlphaStar PFSP):
+        # 'var' weights p(1-p) (focus near-peers), 'hard' weights (1-p)^2
+        # (focus the hardest), 'even' is uniform; p = candidate win rate
+        "pfsp_weighting": "var",
+        # fraction of league generation matches played latest-vs-latest
+        # (pure self-play keeps the candidate from overfitting the pool)
+        "selfplay_rate": 0.2,
+        # promotion gate: the candidate freezes into the population only
+        # once every active opponent has >= promote_games recorded games
+        # AND the candidate's aggregate win points across the pool reach
+        # promote_winrate (win points = wins + draws/2, wp_func convention)
+        "promote_winrate": 0.55,
+        "promote_games": 8,
+        # frozen members kept active for matchmaking (oldest non-anchor
+        # members retire from the pool first; their snapshots and payoff
+        # books persist).  The anchor always stays active
+        "max_population": 16,
+    },
+    # N > 0: when an env's vector twin is autovec-lifted (envs/autovec.py
+    # __autovec__), play N random step-parity games between the numpy
+    # rules and the lifted device env at Learner startup and refuse to
+    # train on a divergent lift.  0 = trust the lift (the parity suite
+    # covers bundled rules)
+    "autovec_verify_games": 0,
     "metrics_path": "metrics.jsonl",
     "model_dir": "models",
     "battle_port": 9876,
@@ -483,6 +514,28 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
             f"train_args.serving.port={serving['port']!r} must be a TCP port "
             "(0 = ephemeral)"
         )
+    league = train["league"]
+    if league["pfsp_weighting"] not in ("var", "hard", "even"):
+        raise ValueError(
+            f"train_args.league.pfsp_weighting={league['pfsp_weighting']!r} "
+            "not one of ('var', 'hard', 'even')"
+        )
+    if not 0.0 <= float(league["selfplay_rate"]) <= 1.0:
+        raise ValueError("train_args.league.selfplay_rate must be in [0, 1]")
+    if not 0.0 < float(league["promote_winrate"]) < 1.0:
+        raise ValueError(
+            "train_args.league.promote_winrate must be in (0, 1) — it is a "
+            "win-points bar over the active population"
+        )
+    if int(league["promote_games"]) < 1:
+        raise ValueError("train_args.league.promote_games must be >= 1")
+    if int(league["max_population"]) < 2:
+        raise ValueError(
+            "train_args.league.max_population must be >= 2 (the anchor "
+            "plus at least one frozen member)"
+        )
+    if int(train["autovec_verify_games"]) < 0:
+        raise ValueError("train_args.autovec_verify_games must be >= 0 (0 = off)")
     if train["seq_attention"] not in ("auto", "flash", "einsum", "ring"):
         raise ValueError(
             f"train_args.seq_attention={train['seq_attention']!r} "

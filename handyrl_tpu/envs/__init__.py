@@ -17,6 +17,10 @@ ENVS = {
     "Geister": "handyrl_tpu.envs.geister",
     "ParallelTicTacToe": "handyrl_tpu.envs.parallel_tictactoe",
     "HungryGeese": "handyrl_tpu.envs.hungry_geese",
+    # the worked custom-env example, first-class so configs can say
+    # `env: ConnectFour` — its device twin is autovec-lifted from pure
+    # numpy rules (envs/autovec.py), no hand-written vector_* module
+    "ConnectFour": "examples.connect_four",
 }
 
 

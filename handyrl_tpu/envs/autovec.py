@@ -1,0 +1,437 @@
+"""Twin-less env compiler: pure numpy single-game rules -> batched jnp
+vector env.
+
+Every device-speed game used to need a HAND-WRITTEN ``vector_*`` twin
+(vector_tictactoe.py and friends): the same rules expressed a second time
+as batched branch-free array ops, kept in lock-step with the host env by
+parity tests.  That porting cost capped scenario diversity at whatever we
+hand-built (ROADMAP item 4).  This module removes the twin: a user writes
+their game ONCE as pure single-game numpy functions (the ``rules``
+namespace below) and ``autovectorize`` lifts them into the episodic
+vector-env contract (``VectorTicTacToe``'s API — the shape
+``runtime/device_rollout.py`` drives) by
+
+1. **rebinding numpy to jnp**: each rules function is rebuilt over a
+   globals dict whose ``numpy`` module aliases point at a jnp shim, so
+   the SAME source executes as host numpy (the testable reference
+   semantics) or as traced jnp (the device program);
+2. **shape/dtype tracing**: every lifted function is abstractly evaluated
+   (``jax.eval_shape``) against the state template at lift time — a
+   non-liftable op (data-dependent python control flow, in-place array
+   mutation, a numpy API with no jnp equivalent) fails HERE, at
+   construction, as an ``AutovecError`` naming the function and the rule
+   it broke, not as a cryptic tracer error inside a rollout thread;
+3. **vmap batching + totality**: the single-game functions are ``vmap``-ed
+   across the game batch, and ``apply`` is made total the way every
+   hand-written twin is (envs/vector_common.py): finished lanes pass
+   through unchanged via a per-lane select, so the user's rules never
+   need to reason about already-terminal games.
+
+Liftability rules (the contract a ``rules`` namespace must satisfy —
+quoted in every AutovecError):
+
+* functions are PURE: same inputs -> same outputs, no mutation of the
+  input state, no global state, no randomness (``np.random`` is refused;
+  stochastic envs thread explicit keys through state instead);
+* arrays are updated OUT-OF-PLACE (``np.where`` / arithmetic — never
+  ``arr[i] = v``, jax arrays are immutable);
+* no python control flow on ARRAY VALUES (``if board[x]:`` fails under
+  tracing; branch with ``np.where``).  Control flow on the static
+  ``step`` argument is fine — it is a python int;
+* fixed shapes and dtypes: every function returns the same shapes for
+  every step, and ``apply`` returns a state tree identical in structure,
+  shape and dtype to its input;
+* ``import numpy as np`` (module import); from-imports of individual
+  numpy functions are not rebound.
+
+The lifted class advertises ``__autovec__ = True`` and carries a
+``verify(n_games, seed)`` step-parity driver (random games stepped
+simultaneously through the numpy rules and the lifted device env, every
+observable compared per step) — wired to the ``autovec_verify_games``
+config knob so a run can self-check the lift at startup.  Scalar-env
+parity (rules vs the 17-method host Environment) stays a test concern,
+same as the hand-written twins (tests/test_device_rollout.py).
+"""
+
+from __future__ import annotations
+
+import types
+from typing import Any, Dict
+
+import numpy as np
+
+_RULES = (
+    "autovec liftability rules: pure functions; out-of-place array "
+    "updates only (jax arrays are immutable); no python control flow on "
+    "array values (np.where instead); fixed shapes/dtypes per function; "
+    "apply() returns a state tree identical in structure/shape/dtype to "
+    "its input; 'import numpy as np' module imports only.  See "
+    "docs/league.md §Autovec liftability."
+)
+
+
+class AutovecError(RuntimeError):
+    """A rules namespace cannot be lifted (or failed step-parity)."""
+
+
+class _JnpShim(types.ModuleType):
+    """Stands in for the ``numpy`` module inside lifted functions: every
+    attribute resolves to its jnp equivalent; APIs jnp does not carry
+    fail loudly with the liftability rules instead of a bare
+    AttributeError deep inside a trace."""
+
+    def __init__(self):
+        super().__init__("autovec_jnp_shim")
+
+    def __getattr__(self, name: str):
+        import jax.numpy as jnp
+
+        if name == "random":
+            raise AutovecError(
+                "np.random is not liftable — randomness must come through "
+                "explicit state carried by the rules (or stay out of the "
+                f"rules entirely).  {_RULES}"
+            )
+        try:
+            return getattr(jnp, name)
+        except AttributeError:
+            raise AutovecError(
+                f"np.{name} has no jax.numpy equivalent; rewrite the rules "
+                f"with liftable ops.  {_RULES}"
+            ) from None
+
+
+_SHIM = _JnpShim()
+
+
+def _rule_functions(rules) -> Dict[str, Any]:
+    """The plain functions defined on the rules namespace (staticmethods
+    unwrapped), keyed by name."""
+    fns: Dict[str, Any] = {}
+    for name, attr in vars(rules).items():
+        if name.startswith("__"):
+            continue
+        if isinstance(attr, staticmethod):
+            fns[name] = attr.__func__
+        elif isinstance(attr, types.FunctionType):
+            fns[name] = attr
+    return fns
+
+
+def _lift_namespace(rules) -> types.SimpleNamespace:
+    """Rebuild every rules function over a globals dict whose numpy
+    module aliases point at the jnp shim.  Intra-namespace helper calls
+    (``MyRules._helper(...)``) resolve to the LIFTED versions: the
+    namespace binds itself under the rules class name in the shared
+    globals."""
+    fns = _rule_functions(rules)
+    if not fns:
+        raise AutovecError(
+            f"{rules.__name__} defines no functions to lift.  {_RULES}"
+        )
+    base_globals = next(iter(fns.values())).__globals__
+    lifted_globals = dict(base_globals)
+    rebound = [k for k, v in base_globals.items() if v is np]
+    for k in rebound:
+        lifted_globals[k] = _SHIM
+    if not rebound:
+        # rules that never touch numpy are legal (pure python int state
+        # would fail elsewhere with better diagnostics), but a module
+        # that from-imported numpy functions is the common trap
+        for k, v in base_globals.items():
+            if getattr(v, "__module__", "").startswith("numpy"):
+                raise AutovecError(
+                    f"global {k!r} is a from-imported numpy function; only "
+                    f"'import numpy as np' module aliases are rebound.  {_RULES}"
+                )
+    ns = types.SimpleNamespace()
+    for name, fn in fns.items():
+        new = types.FunctionType(
+            fn.__code__, lifted_globals, fn.__name__, fn.__defaults__,
+            fn.__closure__,
+        )
+        new.__kwdefaults__ = fn.__kwdefaults__
+        setattr(ns, name, new)
+    # self-reference: MyRules.helper(...) inside a lifted body must hit
+    # the lifted helper, not the numpy original
+    lifted_globals[rules.__name__] = ns
+    return ns
+
+
+def _state_template(rules) -> Dict[str, np.ndarray]:
+    try:
+        template = rules.init()
+    except Exception as exc:
+        raise AutovecError(
+            f"{rules.__name__}.init() failed under host numpy: "
+            f"{type(exc).__name__}: {exc}.  {_RULES}"
+        ) from exc
+    if not isinstance(template, dict) or not template:
+        raise AutovecError(
+            f"{rules.__name__}.init() must return a non-empty dict of "
+            f"numpy arrays (got {type(template).__name__}).  {_RULES}"
+        )
+    out = {}
+    for k, v in template.items():
+        arr = np.asarray(v)
+        if arr.dtype == object:
+            raise AutovecError(
+                f"{rules.__name__}.init()[{k!r}] is not a fixed-dtype "
+                f"array.  {_RULES}"
+            )
+        out[k] = arr
+    return out
+
+
+def _trace(rules_name: str, fn_name: str, fn, *args):
+    """jax.eval_shape with lift-aware diagnostics: the abstract trace is
+    where in-place mutation, value-dependent branching and missing jnp
+    APIs surface — re-raised as AutovecError naming the function."""
+    import jax
+
+    try:
+        return jax.eval_shape(fn, *args)
+    except AutovecError as exc:
+        raise AutovecError(f"{rules_name}.{fn_name}: {exc}") from exc
+    except TypeError as exc:
+        hint = ""
+        if "immutable" in str(exc) or "item assignment" in str(exc):
+            hint = (
+                " (in-place array assignment is not liftable; use "
+                "np.where or arithmetic to build the new array)"
+            )
+        raise AutovecError(
+            f"{rules_name}.{fn_name} is not liftable: {exc}{hint}.  {_RULES}"
+        ) from exc
+    except Exception as exc:
+        hint = ""
+        name = type(exc).__name__
+        if "Tracer" in name or "Concretization" in name:
+            hint = (
+                " (python control flow on an array value — branch with "
+                "np.where instead)"
+            )
+        raise AutovecError(
+            f"{rules_name}.{fn_name} is not liftable: {name}: {exc}{hint}.  "
+            f"{_RULES}"
+        ) from exc
+
+
+def _check_shapes(rules, lifted, template) -> None:
+    """Abstractly evaluate every contract function against the state
+    template; loud diagnostics for shape/dtype contract breaks."""
+    import jax
+
+    name = rules.__name__
+    aval = {
+        k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in template.items()
+    }
+    act = jax.ShapeDtypeStruct((), np.int32)
+    A, P = int(rules.num_actions), int(rules.num_players)
+
+    obs0 = _trace(name, "observation", lambda s: lifted.observation(s, 0), aval)
+    obs1 = _trace(name, "observation", lambda s: lifted.observation(s, 1), aval)
+    if obs0.shape != obs1.shape or obs0.dtype != obs1.dtype:
+        raise AutovecError(
+            f"{name}.observation changes shape/dtype with step "
+            f"({obs0.shape}/{obs0.dtype} at step 0 vs {obs1.shape}/"
+            f"{obs1.dtype} at step 1); the compiled rollout needs one "
+            f"fixed observation spec.  {_RULES}"
+        )
+    legal = _trace(name, "legal_mask", lifted.legal_mask, aval)
+    if legal.shape != (A,) or legal.dtype != np.bool_:
+        raise AutovecError(
+            f"{name}.legal_mask must return a ({A},) bool array "
+            f"(num_actions), got {legal.shape} {legal.dtype}.  {_RULES}"
+        )
+    term = _trace(name, "terminal", lambda s: lifted.terminal(s, 0), aval)
+    if term.shape != () or term.dtype != np.bool_:
+        raise AutovecError(
+            f"{name}.terminal must return a scalar bool, got "
+            f"{term.shape} {term.dtype}.  {_RULES}"
+        )
+    new = _trace(name, "apply", lambda s, a: lifted.apply(s, a, 0), aval, act)
+    if not isinstance(new, dict) or set(new) != set(aval):
+        got = sorted(new) if isinstance(new, dict) else type(new).__name__
+        raise AutovecError(
+            f"{name}.apply must return the same state keys "
+            f"{sorted(aval)}, got {got}.  {_RULES}"
+        )
+    for k in aval:
+        if new[k].shape != aval[k].shape or new[k].dtype != aval[k].dtype:
+            raise AutovecError(
+                f"{name}.apply changes state[{k!r}] from "
+                f"{aval[k].shape} {aval[k].dtype} to {new[k].shape} "
+                f"{new[k].dtype}; state must be shape/dtype-stable or the "
+                f"rollout scan cannot carry it.  {_RULES}"
+            )
+    outc = _trace(name, "outcome", lifted.outcome, aval)
+    if outc.shape != (P,):
+        raise AutovecError(
+            f"{name}.outcome must return a ({P},) per-player score array "
+            f"(num_players), got {outc.shape}.  {_RULES}"
+        )
+
+
+_LIFT_CACHE: Dict[type, type] = {}
+
+
+def autovectorize(rules) -> type:
+    """Lift a pure-numpy single-game ``rules`` namespace into an episodic
+    vector env class (the ``VectorTicTacToe`` contract, consumed by
+    ``runtime/device_rollout.py``) — no hand-written twin.
+
+    ``rules`` is a class/namespace of pure functions over a single game:
+
+        num_actions, max_steps, num_players  (ints)
+        init() -> {name: np.ndarray}                      fresh game state
+        observation(state, step) -> np.ndarray            turn player view
+        legal_mask(state) -> (num_actions,) bool
+        terminal(state, step) -> bool scalar
+        apply(state, action, step) -> state               live games only
+        outcome(state) -> (num_players,) float scores
+
+    The lift is memoized per rules class (tracing is not free), validated
+    at construction, and the returned class exposes
+    ``verify(n_games, seed)`` for random-game step-parity against the
+    numpy execution of the same rules.
+    """
+    cached = _LIFT_CACHE.get(rules)
+    if cached is not None:
+        return cached
+
+    import jax
+    import jax.numpy as jnp
+
+    for attr in ("num_actions", "max_steps", "num_players"):
+        if not isinstance(getattr(rules, attr, None), int):
+            raise AutovecError(
+                f"{getattr(rules, '__name__', rules)!r} needs int attribute "
+                f"{attr!r}.  {_RULES}"
+            )
+    for fn in ("init", "observation", "legal_mask", "terminal", "apply",
+               "outcome"):
+        if not callable(getattr(rules, fn, None)):
+            raise AutovecError(
+                f"{rules.__name__} is missing rules function {fn!r}.  {_RULES}"
+            )
+
+    lifted = _lift_namespace(rules)
+    template = _state_template(rules)
+    _check_shapes(rules, lifted, template)
+
+    def v_init(n_games: int):
+        return {
+            k: jnp.broadcast_to(jnp.asarray(v), (n_games,) + v.shape)
+            for k, v in template.items()
+        }
+
+    def v_observation(state, step: int):
+        return jax.vmap(lambda s: lifted.observation(s, step))(state)
+
+    def v_legal_mask(state):
+        return jax.vmap(lifted.legal_mask)(state)
+
+    def v_terminal(state, step: int):
+        return jax.vmap(lambda s: lifted.terminal(s, step))(state)
+
+    def v_apply(state, actions, step: int):
+        # totality wrapper (the vector_common contract): the user's apply
+        # sees live games only in effect — finished lanes pass through
+        # unchanged via a per-lane select, and whatever the traced apply
+        # computed for them is discarded
+        live = ~v_terminal(state, step)
+        new = jax.vmap(lambda s, a: lifted.apply(s, a, step))(
+            state, actions.astype(jnp.int32)
+        )
+        return jax.tree.map(
+            lambda n, o: jnp.where(
+                live.reshape((-1,) + (1,) * (o.ndim - 1)), n, o
+            ),
+            new,
+            dict(state),
+        )
+
+    def v_outcome(state):
+        return jax.vmap(lifted.outcome)(state).astype(jnp.float32)
+
+    def verify(n_games: int, seed: int = 0) -> None:
+        """Random-game step-parity: ``n_games`` games stepped through the
+        host-numpy rules and the lifted env simultaneously; every
+        observable (observation, legal mask, terminal flag, outcome)
+        compared per step.  Raises AutovecError on the first divergence —
+        the ``autovec_verify_games`` startup self-check."""
+        rng = np.random.default_rng(seed)
+        hosts = [
+            {k: v.copy() for k, v in _state_template(rules).items()}
+            for _ in range(n_games)
+        ]
+        done = np.zeros(n_games, bool)
+        state = v_init(n_games)
+
+        def bail(what, step):
+            raise AutovecError(
+                f"autovec step-parity failed for {rules.__name__}: {what} "
+                f"diverged between the numpy rules and the lifted env at "
+                f"step {step}"
+            )
+
+        for step in range(int(rules.max_steps)):
+            h_term = np.array(
+                [bool(rules.terminal(h, step)) for h in hosts]
+            )
+            if not np.array_equal(
+                h_term, np.asarray(jax.device_get(v_terminal(state, step)))
+            ):
+                bail("terminal", step)
+            h_legal = np.stack([np.asarray(rules.legal_mask(h)) for h in hosts])
+            if not np.array_equal(
+                h_legal, np.asarray(jax.device_get(v_legal_mask(state)))
+            ):
+                bail("legal_mask", step)
+            h_obs = np.stack(
+                [np.asarray(rules.observation(h, step)) for h in hosts]
+            )
+            d_obs = np.asarray(jax.device_get(v_observation(state, step)))
+            if not np.allclose(h_obs, d_obs, atol=1e-6):
+                bail("observation", step)
+            done = h_term
+            if done.all():
+                break
+            actions = np.zeros(n_games, np.int32)
+            for i, h in enumerate(hosts):
+                if done[i]:
+                    continue
+                legal = np.flatnonzero(h_legal[i])
+                actions[i] = rng.choice(legal) if len(legal) else 0
+                hosts[i] = rules.apply(h, int(actions[i]), step)
+            state = v_apply(state, jnp.asarray(actions), step)
+        h_out = np.stack([np.asarray(rules.outcome(h)) for h in hosts])
+        d_out = np.asarray(jax.device_get(v_outcome(state)))
+        if not np.allclose(h_out.astype(np.float32), d_out, atol=1e-6):
+            bail("outcome", int(rules.max_steps))
+
+    cls = type(
+        f"AutoVec{rules.__name__}",
+        (),
+        {
+            "__doc__": (
+                f"Autovectorized device twin of {rules.__name__} "
+                "(envs/autovec.py) — no hand-written vector env."
+            ),
+            "__autovec__": True,
+            "rules": rules,
+            "num_actions": int(rules.num_actions),
+            "max_steps": int(rules.max_steps),
+            "num_players": int(rules.num_players),
+            "init": staticmethod(v_init),
+            "observation": staticmethod(v_observation),
+            "legal_mask": staticmethod(v_legal_mask),
+            "terminal": staticmethod(v_terminal),
+            "apply": staticmethod(v_apply),
+            "outcome": staticmethod(v_outcome),
+            "verify": staticmethod(verify),
+        },
+    )
+    _LIFT_CACHE[rules] = cls
+    return cls

@@ -134,6 +134,75 @@ class Environment(BaseEnvironment):
         return SimpleConvNet()
 
 
+class TicTacToeRules:
+    """Pure single-game numpy rules to the autovec liftability contract
+    (envs/autovec.py) — the same rules as ``Environment`` and the
+    hand-written ``VectorTicTacToe`` twin.
+
+    This namespace exists as the apples-to-apples yardstick for the
+    twin-less path: the ``league`` bench stage lifts it with
+    ``autovectorize`` and measures per-chip self-play throughput against
+    the hand-written ``vector_tictactoe.VectorTicTacToe`` — same game,
+    same net, so the frac isolates the cost of the lift itself.
+    Bit-parity of every observable against the hand twin is pinned by
+    tests/test_autovec.py.
+
+    State (one game): ``cells`` (9,) int8, ``winner`` () int8.
+    """
+
+    num_actions = 9
+    max_steps = 9
+    num_players = 2
+
+    @staticmethod
+    def _color(step: int) -> int:
+        return 1 if step % 2 == 0 else -1
+
+    @staticmethod
+    def init():
+        return {
+            "cells": np.zeros(9, np.int8),
+            "winner": np.zeros((), np.int8),
+        }
+
+    @staticmethod
+    def observation(state, step: int):
+        """(3, 3, 3) planes for the turn player — identical to
+        ``VectorTicTacToe.observation``: [my-view ones, my stones,
+        opponent stones]."""
+        me = TicTacToeRules._color(step)
+        grid = state["cells"].reshape(3, 3)
+        return np.stack(
+            [
+                np.ones((3, 3), np.float32),
+                (grid == me).astype(np.float32),
+                (grid == -me).astype(np.float32),
+            ]
+        )
+
+    @staticmethod
+    def legal_mask(state):
+        return state["cells"] == 0
+
+    @staticmethod
+    def terminal(state, step: int):
+        return (state["winner"] != 0) | (step >= 9)
+
+    @staticmethod
+    def apply(state, action, step: int):
+        me = TicTacToeRules._color(step)
+        cells = np.where(np.arange(9) == action, np.int8(me), state["cells"])
+        lines = cells[WIN_LINES]                              # (8, 3)
+        won = (lines.sum(axis=-1) == 3 * me).any()
+        winner = np.where(won, np.int8(me), state["winner"]).astype(np.int8)
+        return {"cells": cells, "winner": winner}
+
+    @staticmethod
+    def outcome(state):
+        w = state["winner"].astype(np.float32)
+        return np.stack([w, -w])
+
+
 if __name__ == "__main__":
     e = Environment()
     for _ in range(10):

@@ -1,0 +1,21 @@
+"""Population-based league training plane (docs/league.md).
+
+AlphaStar-style league over the env zoo: a persistent population of
+frozen snapshots + anchors (league.py) backed by the manifest-verified
+checkpoint store, PFSP matchmaking over a shared payoff ledger
+(matchmaker.py — the repo's ONE win-rate bookkeeping, also fed by
+runtime/battle.py network matches and tools/head_to_head.py), and a
+learner driver that routes frozen opponents through resident ModelRouter
+engines so distinct opponents dispatch concurrently on distinct chips
+(learner.py).  Entry point: ``main.py --league``.
+"""
+
+from .league import ANCHOR, CANDIDATE, League, Member
+from .learner import LeagueLearner, LeagueModelServer, league_main
+from .matchmaker import Matchmaker, PayoffMatrix, pfsp_weights
+
+__all__ = [
+    "ANCHOR", "CANDIDATE", "League", "Member",
+    "LeagueLearner", "LeagueModelServer", "league_main",
+    "Matchmaker", "PayoffMatrix", "pfsp_weights",
+]

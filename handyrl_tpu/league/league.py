@@ -1,0 +1,184 @@
+"""League registry: the persistent population behind league training.
+
+A population member is a ROLE plus a CHECKPOINT EPOCH in the PR 2
+manifest-verified store (``models/{epoch}.ckpt``):
+
+* ``anchor``  — the fixed reference opponent (epoch 0 = the zero-output
+  RandomModel, ``LocalModelServer.get(0)`` semantics).  Anchors never
+  retire: they give the payoff matrix a stationary column, which is what
+  makes Elo comparable across the run;
+* ``frozen``  — a past main-agent snapshot frozen by the promotion gate
+  (named ``main-{epoch}``), the fictitious-self-play pool;
+* ``main``    — the live training candidate (tracked for bookkeeping; it
+  plays under the reserved name ``candidate`` until frozen);
+* ``exploiter`` — a member registered to attack a specific main (the
+  AlphaStar role); the registry and matchmaker carry the role, and a
+  separate ``--league`` run with its own model_dir trains one.
+
+The registry (members + the payoff ledger) persists to
+``models/LEAGUE.json`` with the checkpoint plane's atomic-write
+discipline, so a league run resumes with its population and books
+intact.  On load, frozen members whose snapshots no longer digest-verify
+are DROPPED LOUDLY (their books survive): matching against a corrupt
+snapshot would silently substitute latest params and poison the matrix
+(the LocalModelServer substitution lesson).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..runtime.checkpoint import atomic_write_bytes, verify_snapshot
+from .matchmaker import PayoffMatrix
+
+LEAGUE_NAME = "LEAGUE.json"
+CANDIDATE = "candidate"      # the live (not yet frozen) main agent's ledger name
+ANCHOR = "random"            # the epoch-0 RandomModel anchor
+
+ROLES = ("anchor", "frozen", "main", "exploiter")
+
+
+@dataclass
+class Member:
+    name: str
+    epoch: int
+    role: str = "frozen"
+    frozen_at_step: int = 0
+
+    def __post_init__(self):
+        if self.role not in ROLES:
+            raise ValueError(f"member role {self.role!r} not one of {ROLES}")
+
+
+class League:
+    """Population registry + the shared payoff ledger, disk-backed."""
+
+    def __init__(self, model_dir: str, league_args: Optional[Dict[str, Any]] = None):
+        cfg = dict(league_args or {})
+        self.model_dir = model_dir
+        self.max_population = max(2, int(cfg.get("max_population", 16)))
+        self.members: Dict[str, Member] = {}
+        self.payoff = PayoffMatrix()
+        self.promotions = 0
+        # registry file ownership: under jax.distributed exactly one
+        # process may write models/LEAGUE.json (the same coordinator-only
+        # discipline as checkpoints/metrics) — LeagueLearner flips this
+        # off on non-coordinators; their in-memory state stays live
+        self.owner = True
+        if not self.load():
+            # fresh league: the anchor seeds the population so the very
+            # first candidate generation has an opponent and a fixed Elo
+            # reference
+            self.members[ANCHOR] = Member(ANCHOR, 0, "anchor")
+
+    # -- membership -----------------------------------------------------------
+
+    def add(self, name: str, epoch: int, role: str = "frozen",
+            frozen_at_step: int = 0) -> Member:
+        if name in self.members:
+            raise ValueError(f"league member {name!r} already registered")
+        if name == CANDIDATE:
+            raise ValueError(
+                f"{CANDIDATE!r} is the reserved ledger name of the live "
+                "candidate; frozen members need concrete names"
+            )
+        member = Member(name, int(epoch), role, int(frozen_at_step))
+        self.members[name] = member
+        return member
+
+    def freeze_candidate(self, epoch: int, steps: int = 0) -> Member:
+        """The promotion gate passed: freeze the candidate's current
+        snapshot into the population as ``main-{epoch}``, and hand the
+        candidate's ledger row to the new member (the games that earned
+        the promotion describe the frozen policy) so the next candidate
+        generation starts with clean books."""
+        member = self.add(f"main-{int(epoch)}", epoch, "frozen", steps)
+        self.payoff.adopt(CANDIDATE, member.name)
+        self.promotions += 1
+        self.save()
+        return member
+
+    def opponent_pool(self) -> List[Member]:
+        """Active matchmaking pool: anchors + the newest frozen members up
+        to ``max_population`` (anchors always stay; older frozen members
+        retire from matchmaking but keep their snapshots and books)."""
+        anchors = [m for m in self.members.values() if m.role == "anchor"]
+        frozen = sorted(
+            (m for m in self.members.values() if m.role in ("frozen", "exploiter")),
+            key=lambda m: m.epoch,
+        )
+        slots = max(0, self.max_population - len(anchors))
+        return anchors + frozen[-slots:] if slots else anchors
+
+    def frozen_epochs(self) -> List[int]:
+        """Every registered snapshot epoch (checkpoint-GC pin set) —
+        retired members included: their books reference those params."""
+        return sorted({m.epoch for m in self.members.values() if m.epoch > 0})
+
+    # -- persistence ------------------------------------------------------------
+
+    def _path(self) -> str:
+        return os.path.join(self.model_dir, LEAGUE_NAME)
+
+    def save(self) -> None:
+        if not self.owner:
+            return
+        payload = {
+            "version": 1,
+            "promotions": self.promotions,
+            "members": [asdict(m) for m in self.members.values()],
+            "payoff": self.payoff.to_dict(),
+        }
+        atomic_write_bytes(
+            self._path(), json.dumps(payload, indent=1, sort_keys=True).encode()
+        )
+
+    def load(self) -> bool:
+        """Restore a persisted league; False when none exists.  Frozen
+        members whose snapshots fail digest verification are dropped
+        loudly (books survive — the next promotion may resurrect the
+        name-space but never the corrupt file)."""
+        try:
+            with open(self._path()) as f:
+                payload = json.load(f)
+        except FileNotFoundError:
+            return False
+        except OSError as exc:
+            # the file EXISTS but cannot be read (EACCES, EIO, an NFS
+            # blip): starting a fresh anchor-only league here would empty
+            # the GC pin set and let gc_snapshots permanently delete the
+            # frozen members' snapshots — fail loudly instead
+            raise RuntimeError(
+                f"{self._path()} exists but cannot be read "
+                f"({type(exc).__name__}: {exc}); refusing to start a fresh "
+                "league over an unreadable registry (its frozen members' "
+                "snapshots would be GC'd)"
+            )
+        except ValueError as exc:
+            raise RuntimeError(
+                f"{self._path()} is corrupt ({exc}); the league registry is "
+                "atomic-write — inspect the model dir (delete the file to "
+                "explicitly start a fresh league)"
+            )
+        self.promotions = int(payload.get("promotions", 0))
+        self.payoff = PayoffMatrix.from_dict(payload.get("payoff", {}))
+        self.members = {}
+        for raw in payload.get("members", []):
+            member = Member(
+                str(raw["name"]), int(raw["epoch"]), str(raw.get("role", "frozen")),
+                int(raw.get("frozen_at_step", 0)),
+            )
+            if member.epoch > 0 and verify_snapshot(self.model_dir, member.epoch) is False:
+                print(
+                    f"[handyrl_tpu] league: dropping member {member.name!r} — "
+                    f"snapshot {member.epoch}.ckpt fails digest verification "
+                    "(its payoff books are kept)"
+                )
+                continue
+            self.members[member.name] = member
+        if ANCHOR not in self.members:
+            self.members[ANCHOR] = Member(ANCHOR, 0, "anchor")
+        return True

@@ -31,6 +31,61 @@ from .evaluation import build_agent, exec_network_match, load_model_agent, wp_fu
 BATTLE_PORT = 9876
 
 
+class PeerSevered(RuntimeError):
+    """A remote peer's connection died mid-match; carries the seat so the
+    match can be scored as a forfeit instead of silently killing the
+    match thread."""
+
+    def __init__(self, player):
+        super().__init__(f"peer for player {player} severed mid-match")
+        self.player = player
+
+
+def forfeit_outcome(players, severed_player):
+    """Outcome dict for a severed-peer forfeit: the severed seat scores
+    -1, every surviving seat +1.  The payoff ledger refines this pairwise
+    (survivors beat the forfeiter; survivor-vs-survivor pairs are NOT
+    recorded — see PayoffMatrix.record_forfeit)."""
+    return {
+        p: (-1.0 if p == severed_player else 1.0) for p in players
+    }
+
+
+def exec_recorded_match(env, network_agents, names=None, payoff=None,
+                        game_args=None):
+    """``exec_network_match`` + the outcome accounting the league payoff
+    matrix consumes: finished games record pairwise (draws as half-wins,
+    multi-player placements decomposed by score), a severed peer records
+    a forfeit.  Returns ``(outcome, severed_player)`` — outcome is the
+    forfeit dict when a peer died, or None on an env-level error (which
+    records NOTHING: a broken game carries no information about relative
+    strength).
+
+    ``names`` maps seats to ledger member names (defaults to
+    ``seat{p}``); ``payoff`` is any PayoffMatrix-shaped ledger (None =
+    play without books).
+    """
+    names = names or {p: f"seat{p}" for p in env.players()}
+    try:
+        outcome = exec_network_match(env, network_agents, game_args=game_args)
+    except PeerSevered as exc:
+        if env.terminal():
+            # the game FINISHED and the peer died during the outcome-
+            # notification round (a client exiting right after its last
+            # move): the master env holds the real result — booking a
+            # forfeit here would record a loss for an actual winner
+            outcome = env.outcome()
+            if payoff is not None:
+                payoff.record_outcome(names, outcome)
+            return outcome, None
+        if payoff is not None:
+            payoff.record_forfeit(names, exc.player)
+        return forfeit_outcome(env.players(), exc.player), exc.player
+    if outcome is not None and payoff is not None:
+        payoff.record_outcome(names, outcome)
+    return outcome, None
+
+
 class NetworkAgentClient:
     """Client-side command loop: local agent + replica env (evaluation.py:32-63)."""
 
@@ -69,22 +124,34 @@ class NetworkAgentClient:
 
 
 class NetworkAgent:
-    """Server-side RPC proxy for a remote client (evaluation.py:66-80)."""
+    """Server-side RPC proxy for a remote client (evaluation.py:66-80).
 
-    def __init__(self, conn: FramedConnection):
+    Every RPC converts a dead/stalled connection into ``PeerSevered``
+    carrying this proxy's seat, so ``exec_recorded_match`` can score the
+    match as a forfeit for the right player instead of the exception
+    killing the match thread anonymously."""
+
+    def __init__(self, conn: FramedConnection, player=None):
         self.conn = conn
+        self.player = player
+
+    def _rpc(self, payload):
+        try:
+            return send_recv(self.conn, payload)
+        except (OSError, EOFError, ConnectionResetError, TimeoutError) as exc:
+            raise PeerSevered(self.player) from exc
 
     def update(self, data, reset: bool):
-        return send_recv(self.conn, ("update", (data, reset)))
+        return self._rpc(("update", (data, reset)))
 
     def outcome(self, outcome):
-        return send_recv(self.conn, ("outcome", float(outcome)))
+        return self._rpc(("outcome", float(outcome)))
 
     def action(self, player: int):
-        return send_recv(self.conn, ("action", player))
+        return self._rpc(("action", player))
 
     def observe(self, player: int):
-        return send_recv(self.conn, ("observe", player))
+        return self._rpc(("observe", player))
 
 
 def network_match_acception(n_games: int, env_args: Dict[str, Any], num_agents: int, port: int):
@@ -130,19 +197,32 @@ def eval_server_main(args: Dict[str, Any], argv: List[str], port: Optional[int] 
     port = port or int(args["train_args"].get("battle_port", BATTLE_PORT))
 
     print("network match server mode")
+    from ..league.matchmaker import PayoffMatrix
+
     total: Dict[Any, int] = {}
+    # the session ledger: one PayoffMatrix (the league's bookkeeping) per
+    # serve session, seats named by join order — network matches and
+    # league matches share one accounting of draws/placements/forfeits
+    payoff = PayoffMatrix()
     lock = threading.Lock()
     threads: List[threading.Thread] = []
 
     def run_match(game: int, conns: List[FramedConnection]) -> None:
         env = make_env(env_args)
-        agents = {p: NetworkAgent(conn) for p, conn in zip(env.players(), conns)}
-        outcome = exec_network_match(env, agents)
+        agents = {
+            p: NetworkAgent(conn, p) for p, conn in zip(env.players(), conns)
+        }
+        names = {p: f"seat{p}" for p in env.players()}
+        outcome, severed = exec_recorded_match(env, agents, names, _Locked(payoff, lock))
+        if severed is not None:
+            print("game %d: seat %s severed — forfeit, outcome = %s"
+                  % (game, severed, outcome))
         if outcome is not None:
             o = outcome[env.players()[0]]
             with lock:
                 total[o] = total.get(o, 0) + 1
-            print("game %d: outcome = %s" % (game, outcome))
+            if severed is None:
+                print("game %d: outcome = %s" % (game, outcome))
         for conn in conns:
             try:
                 conn.send(("quit", None))
@@ -158,6 +238,29 @@ def eval_server_main(args: Dict[str, Any], argv: List[str], port: Optional[int] 
     for t in threads:
         t.join()
     print("total = %.3f (%d)" % (wp_func(total), sum(total.values())))
+    seats = [f"seat{p}" for p in master_env.players()]
+    wp0 = payoff.aggregate_win_points(seats[0], seats[1:])
+    if wp0 is not None:
+        print(
+            "payoff: %s wp vs field = %.3f over %d match(es), %d forfeit(s)"
+            % (seats[0], wp0, payoff.matches, payoff.forfeits)
+        )
+
+
+class _Locked:
+    """Serialize one ledger's record_* calls across match threads."""
+
+    def __init__(self, payoff, lock):
+        self._payoff = payoff
+        self._lock = lock
+
+    def record_outcome(self, names, outcome):
+        with self._lock:
+            self._payoff.record_outcome(names, outcome)
+
+    def record_forfeit(self, names, severed_seat):
+        with self._lock:
+            self._payoff.record_forfeit(names, severed_seat)
 
 
 def eval_client_main(args: Dict[str, Any], argv: List[str], port: Optional[int] = None) -> None:

@@ -334,7 +334,7 @@ def _newest_verified_recorded(model_dir: str) -> int:
     return 0
 
 
-def gc_snapshots(model_dir: str, keep: int) -> List[int]:
+def gc_snapshots(model_dir: str, keep: int, pin=()) -> List[int]:
     """Delete epoch snapshots older than the newest ``keep`` (0 = keep
     all), pruning their manifest entries.  Only ``{N}.ckpt`` files are
     touched; latest.ckpt / state.ckpt always survive.  Returns the epochs
@@ -346,7 +346,13 @@ def gc_snapshots(model_dir: str, keep: int) -> List[int]:
     ``keep`` snapshots are all corrupt, collecting the last verified one
     would turn a one-epoch rollback into a from-scratch restart.  The
     verification walk is newest-first, so on a healthy directory it costs
-    one digest stream of the just-saved snapshot."""
+    one digest stream of the just-saved snapshot.
+
+    ``pin`` names further epochs the caller needs durable beyond the
+    retention window — the league's frozen population members reference
+    their snapshots for the whole run (handyrl_tpu/league), and a frozen
+    opponent GC'd mid-run would silently flip matches onto substitute
+    params and poison the payoff books."""
     if keep <= 0:
         return []
     try:
@@ -359,8 +365,8 @@ def gc_snapshots(model_dir: str, keep: int) -> List[int]:
     doomed = epochs[:-keep] if len(epochs) > keep else []
     if not doomed:
         return []
-    pinned = _newest_verified_recorded(model_dir)
-    doomed = [e for e in doomed if e != pinned]
+    pinned = {_newest_verified_recorded(model_dir)} | {int(e) for e in pin}
+    doomed = [e for e in doomed if e not in pinned]
     if not doomed:
         return []
     for epoch in doomed:

@@ -189,7 +189,7 @@ class Learner:
                 # adopts Adam moments + step count + lr EMA, but only when
                 # the file matches restart_epoch (an earlier epoch = branch)
                 self.trainer.load_state(state_path, self.model_epoch)
-        self.model_server = LocalModelServer(self.module, make_env(args["env_args"]), self.args)
+        self.model_server = self._make_model_server(args)
         self.model_server.publish(self.model_epoch, params)
 
         self.remote = remote
@@ -256,6 +256,16 @@ class Learner:
                     f"{args['env_args'].get('env')} exposes no vector_env()"
                 )
             self._venv = vector_env()
+            n_verify = int(self.args.get("autovec_verify_games", 0))
+            if n_verify > 0 and getattr(self._venv, "__autovec__", False):
+                # autovec-lifted twin: refuse to train on a divergent lift
+                # (random-game step-parity vs the numpy rules; raises
+                # AutovecError naming the diverged observable)
+                self._venv.verify(n_verify, int(self.args["seed"]))
+                print(
+                    f"autovec twin verified: {self._venv.__name__} parity "
+                    f"over {n_verify} random games"
+                )
             if self._plane == "split" and not hasattr(self._venv, "record"):
                 raise ValueError(
                     "plane: split needs a STREAMING vector env (record/"
@@ -380,6 +390,25 @@ class Learner:
             self._device_eval = DeviceEvaluator(
                 venv, self.module, n_lanes=lanes, opponent=opp, mesh=mesh,
             )
+
+    # -- subclass hooks (league/learner.py overrides these) -------------------
+
+    def _make_model_server(self, args: Dict[str, Any]):
+        """The model-id -> handle server actors resolve through; the
+        league plane substitutes a ModelRouter-backed variant so frozen
+        opponents get resident engines on distinct chips."""
+        return LocalModelServer(self.module, make_env(args["env_args"]), self.args)
+
+    def _epoch_hook(self, record: Dict[str, Any]) -> None:
+        """Called at each epoch boundary just before the metrics record is
+        written (snapshot for the new epoch already saved) — subsystems add
+        their per-epoch bookkeeping/metrics here."""
+
+    def _gc_pinned(self):
+        """Epochs checkpoint GC must never collect (beyond the newest
+        verified snapshot, which gc_snapshots always pins): the league pins
+        its frozen population members here."""
+        return ()
 
     # -- request plumbing ---------------------------------------------------
 
@@ -555,6 +584,7 @@ class Learner:
         self._epoch_t0 = now
         self._epoch_steps0 = steps
         self._epoch_episodes0 = self.num_returned_episodes
+        self._epoch_hook(record)
         self._write_metrics(record)
 
     def update_model(self, params, steps: int) -> None:
@@ -573,7 +603,11 @@ class Learner:
                 self.trainer.save_payload(self.model_epoch),
                 steps,
             )
-            gc_snapshots(self.model_dir, int(self.args.get("keep_checkpoints", 0)))
+            gc_snapshots(
+                self.model_dir,
+                int(self.args.get("keep_checkpoints", 0)),
+                pin=self._gc_pinned(),
+            )
         self.model_server.publish(self.model_epoch, params)
 
     def _repair_metrics_tail(self, path: str) -> None:
@@ -726,7 +760,11 @@ class Learner:
         self.model_epoch += 1
         params, payload, steps = self.trainer.drain_payload(self.model_epoch)
         save_epoch_snapshot(self.model_dir, self.model_epoch, params, payload, steps)
-        gc_snapshots(self.model_dir, int(self.args.get("keep_checkpoints", 0)))
+        gc_snapshots(
+            self.model_dir,
+            int(self.args.get("keep_checkpoints", 0)),
+            pin=self._gc_pinned(),
+        )
         print(
             f"[handyrl_tpu] drain checkpoint: epoch {self.model_epoch} at "
             f"step {steps} (manifest-verified; resume with restart_epoch: -1)",
@@ -810,7 +848,7 @@ class Learner:
                 if self.args["epochs"] >= 0 and self.model_epoch >= self.args["epochs"]:
                     self.shutdown_flag = True
         self.trainer.stop()
-        self.model_server.engine.stop()
+        self.model_server.stop()
         # resolve any futures enqueued after the loop's final iteration
         # (e.g. the device-rollout thread racing shutdown) — a blocked
         # handle() would otherwise leak a permanently waiting thread

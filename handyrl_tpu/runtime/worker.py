@@ -73,6 +73,11 @@ class LocalModelServer:
         with self._lock:
             return self.model_id, self._model.variables["params"]
 
+    def stop(self) -> None:
+        """Release the serving plane (Learner teardown); subclasses with
+        more resident machinery (the league's router engines) extend it."""
+        self.engine.stop()
+
     def get(self, model_id: int):
         if model_id == 0:
             return self._random

@@ -43,6 +43,14 @@ METRIC_KEYS = frozenset({
     "serve_shed", "serve_deadline_miss", "serve_batches", "serve_qps",
     "serve_p50_ms", "serve_p99_ms", "serve_hot_swaps", "serve_models",
     "serve_connections", "serve_errors",
+    # league plane (handyrl_tpu/league): per-epoch population health from
+    # LeagueLearner._epoch_hook — exact keys, like serve_*, so every new
+    # league stat is reviewed here.  league_matches/forfeits/promotions
+    # are cumulative; league_candidate_wp and league_elo_spread are null
+    # until the respective books have games
+    "league_population", "league_pool", "league_matches", "league_forfeits",
+    "league_payoff_coverage", "league_candidate_wp", "league_elo_spread",
+    "league_promotions",
 })
 # key families written from the *_KEYS tuples (trainer/learner) and the
 # per-epoch plane-health diffs; one prefix registers the family

@@ -6,6 +6,8 @@ Modes:
     --worker / -w            worker machine connecting to a train server
     --serve / -s             standalone inference serving plane
                              (continuous batching + hot-swap; docs/serving.md)
+    --league / -l            population-based league training (PFSP
+                             matchmaking + promotion gate; docs/league.md)
     --eval / -e              MODEL_PATH NUM_GAMES NUM_PROCESS
     --eval-server / -es      network battle server
     --eval-client / -ec      network battle client
@@ -63,6 +65,12 @@ if __name__ == "__main__":
         from handyrl_tpu.serving import serve_main
 
         serve_main(args)
+    elif mode in ("--league", "-l"):
+        from handyrl_tpu.league import league_main
+        from handyrl_tpu.parallel import init_distributed
+
+        init_distributed(args["train_args"].get("distributed"))
+        league_main(args)
     elif mode in ("--eval", "-e"):
         from handyrl_tpu.runtime.evaluation import eval_main
 

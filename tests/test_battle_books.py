@@ -1,0 +1,173 @@
+"""Network-match bookkeeping pins (runtime/battle.py).
+
+The payoff matrix consumes ``exec_network_match`` results, so its
+outcome accounting — draws, multi-player placements, severed-peer
+forfeits — is pinned here against the REAL match executor and env rules,
+socket-free: scripted agents speak the NetworkAgentClient protocol
+(update/action/observe/outcome over a replica env) and sever on cue.
+"""
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.envs import make_env
+from handyrl_tpu.league.matchmaker import PayoffMatrix
+from handyrl_tpu.runtime.battle import (
+    PeerSevered,
+    exec_recorded_match,
+    forfeit_outcome,
+)
+
+pytestmark = pytest.mark.league
+
+
+class ScriptedPeer:
+    """A NetworkAgent-shaped peer: replica env synced by deltas, moves
+    from a script, optionally severing (connection death) at move k or
+    during the final outcome-notification round."""
+
+    def __init__(self, env_name, player, moves, sever_at=None,
+                 sever_on_outcome=False):
+        self.env = make_env({"env": env_name})
+        self.player = player
+        self.moves = list(moves)
+        self.sever_at = sever_at
+        self.sever_on_outcome = sever_on_outcome
+        self.final_outcome = None
+        self._move_i = 0
+
+    def update(self, info, reset):
+        self._maybe_sever()
+        self.env.update(info, reset)
+
+    def action(self, player):
+        self._maybe_sever()
+        a = self.moves[self._move_i]
+        self._move_i += 1
+        return self.env.action2str(a, player)
+
+    def observe(self, player):
+        return None
+
+    def outcome(self, outcome):
+        if self.sever_on_outcome:
+            raise PeerSevered(self.player)
+        self.final_outcome = outcome
+
+    def _maybe_sever(self):
+        if self.sever_at is not None and self._move_i >= self.sever_at:
+            raise PeerSevered(self.player)
+
+
+# X at 0,1,5,6,8 / O at 2,3,4,7 — no line of three: a drawn game
+DRAW_X = [0, 1, 5, 6, 8]
+DRAW_O = [2, 3, 4, 7]
+# X takes the top row before O finishes anything
+WIN_X = [0, 1, 2]
+WIN_O = [3, 4]
+
+
+def _play(moves_x, moves_o, payoff=None, names=None, sever_x_at=None):
+    env = make_env({"env": "TicTacToe"})
+    agents = {
+        0: ScriptedPeer("TicTacToe", 0, moves_x, sever_at=sever_x_at),
+        1: ScriptedPeer("TicTacToe", 1, moves_o),
+    }
+    outcome, severed = exec_recorded_match(env, agents, names, payoff)
+    return env, agents, outcome, severed
+
+
+def test_decisive_game_records_pairwise():
+    p = PayoffMatrix()
+    _, agents, outcome, severed = _play(
+        WIN_X, WIN_O, p, names={0: "alice", 1: "bob"}
+    )
+    assert severed is None
+    assert outcome == {0: 1, 1: -1}
+    assert p.win_points("alice", "bob") == 1.0
+    assert p.win_points("bob", "alice") == 0.0
+    assert p.matches == 1 and p.forfeits == 0
+    # both replica envs saw the delta-synced game and the final outcome
+    assert agents[0].final_outcome == 1
+    assert agents[1].final_outcome == -1
+    assert agents[0].env.terminal() and agents[1].env.terminal()
+
+
+def test_draw_records_half_win_each_way():
+    p = PayoffMatrix()
+    _, _, outcome, severed = _play(DRAW_X, DRAW_O, p, {0: "alice", 1: "bob"})
+    assert severed is None
+    assert outcome == {0: 0, 1: 0}
+    assert p.win_points("alice", "bob") == pytest.approx(0.5)
+    assert p.win_points("bob", "alice") == pytest.approx(0.5)
+
+
+def test_severed_peer_forfeits_with_books():
+    """A peer dying mid-game must neither kill the match thread nor
+    vanish from the books: the severed seat takes the loss, the match
+    counts, and the returned outcome says who forfeited."""
+    p = PayoffMatrix()
+    _, _, outcome, severed = _play(
+        WIN_X, WIN_O, p, {0: "alice", 1: "bob"}, sever_x_at=2
+    )
+    assert severed == 0
+    assert outcome == {0: -1.0, 1: 1.0}
+    assert p.win_points("bob", "alice") == 1.0
+    assert p.win_points("alice", "bob") == 0.0
+    assert p.matches == 1 and p.forfeits == 1
+
+
+def test_sever_during_outcome_delivery_keeps_real_result():
+    """A client that wins and then drops its connection before the
+    server's outcome round played a FINISHED game: the master env holds
+    the real result, and booking a forfeit would record a loss for an
+    actual winner — the true outcome must land in the books."""
+    p = PayoffMatrix()
+    env = make_env({"env": "TicTacToe"})
+    agents = {
+        0: ScriptedPeer("TicTacToe", 0, WIN_X, sever_on_outcome=True),
+        1: ScriptedPeer("TicTacToe", 1, WIN_O),
+    }
+    outcome, severed = exec_recorded_match(
+        env, agents, {0: "alice", 1: "bob"}, p
+    )
+    assert severed is None
+    assert outcome == {0: 1, 1: -1}
+    assert p.win_points("alice", "bob") == 1.0
+    assert p.forfeits == 0 and p.matches == 1
+
+
+def test_default_names_are_seats():
+    p = PayoffMatrix()
+    _play(WIN_X, WIN_O, p)   # no names: seat{p} convention
+    assert p.win_points("seat0", "seat1") == 1.0
+
+
+def test_no_ledger_still_plays():
+    _, _, outcome, severed = _play(WIN_X, WIN_O, payoff=None)
+    assert outcome == {0: 1, 1: -1} and severed is None
+
+
+def test_forfeit_outcome_multiplayer_shape():
+    out = forfeit_outcome([0, 1, 2, 3], 2)
+    assert out == {0: 1.0, 1: 1.0, 2: -1.0, 3: 1.0}
+
+
+def test_multiplayer_match_placements_via_ledger():
+    """A 4-player HungryGeese-style placement outcome decomposes into
+    pairwise entries when recorded by the same ledger battle matches use
+    (no extra convention between battle and league accounting)."""
+    p = PayoffMatrix()
+    names = {0: "a", 1: "b", 2: "c", 3: "d"}
+    p.record_outcome(names, {0: 1.0, 1: 1 / 3, 2: -1 / 3, 3: -1.0})
+    got = np.array([
+        [np.nan if a == b else p.win_points(a, b) for b in "abcd"]
+        for a in "abcd"
+    ])
+    want = np.array([
+        [np.nan, 1.0, 1.0, 1.0],
+        [0.0, np.nan, 1.0, 1.0],
+        [0.0, 0.0, np.nan, 1.0],
+        [0.0, 0.0, 0.0, np.nan],
+    ])
+    np.testing.assert_array_equal(got, want)

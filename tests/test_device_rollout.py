@@ -108,12 +108,13 @@ def test_device_episodes_train():
 
 def test_custom_env_device_twin_replays_legally():
     """The custom-env example's device twin (examples.connect_four
-    VectorConnectFour — the worked 'write your own vector env' example)
-    must clear the same rules-parity bar as the bundled twins: every
+    ConnectFourRules lifted by envs/autovec.py — the worked 'write your
+    game once' twin-less example, no hand-written vector env) must clear
+    the same rules-parity bar as the bundled hand twins: every
     device-generated game replays legally through the host rules with the
     identical outcome, and the recorded observations match the host
     views."""
-    from examples.connect_four import Environment, VectorConnectFour
+    from examples.connect_four import Environment
 
     env = Environment()
     module = env.net()
@@ -126,7 +127,9 @@ def test_custom_env_device_twin_replays_legally():
     )
     args = dict(cfg["train_args"])
     args["env"] = cfg["env_args"]
-    roll = DeviceRollout(VectorConnectFour, module, args, n_games=32)
+    venv = Environment.vector_env()
+    assert getattr(venv, "__autovec__", False), "example twin must be autovec-lifted"
+    roll = DeviceRollout(venv, module, args, n_games=32)
     episodes = roll.generate(variables["params"], jax.random.PRNGKey(7))
     assert len(episodes) == 32
     saw_win = False

@@ -19,10 +19,21 @@ ENV_NAMES = [
     "ParallelTicTacToe",
     "Geister",
     "HungryGeese",
-    # dotted-path custom env (docs/custom_environment.md): the example
-    # Connect Four exercises the registry fallback the way a user would
+    # first-class zoo entry for the worked example (league/autovec bench
+    # legs run against it as a registered scenario)
+    "ConnectFour",
+    # ...and the same module by dotted path, exercising the registry
+    # fallback the way a user would (docs/custom_environment.md)
     "examples.connect_four",
 ]
+
+
+def test_connect_four_registry_entry_is_the_example_module():
+    """`env: ConnectFour` must resolve to the same Environment class as
+    the documented dotted path — one module, two spellings."""
+    a = make_env({"env": "ConnectFour"})
+    b = make_env({"env": "examples.connect_four"})
+    assert type(a) is type(b)
 
 
 def _make(name):

@@ -1,0 +1,532 @@
+"""League training plane tests (handyrl_tpu/league).
+
+Units: the payoff ledger's pairwise accounting, PFSP weighting, the
+registry's persistence/verification/capping, the promotion-gate
+book-keeping, and the ModelRouter-backed opponent serving.  The
+end-to-end acceptance run (the ISSUE 11 bar) trains a TicTacToe league
+on the virtual CPU mesh until a >=3-member population exists: PFSP
+matches fill the payoff matrix for every active pair, at least one
+candidate clears the promotion gate and freezes, and league_* metrics
+land in metrics.jsonl.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.config import normalize_args
+from handyrl_tpu.league import (
+    ANCHOR,
+    CANDIDATE,
+    League,
+    Matchmaker,
+    PayoffMatrix,
+    pfsp_weights,
+)
+
+pytestmark = pytest.mark.league
+
+
+# ---------------------------------------------------------------------------
+# payoff ledger
+# ---------------------------------------------------------------------------
+
+
+class TestPayoffMatrix:
+    def test_pairwise_wins_draws_losses(self):
+        p = PayoffMatrix()
+        p.record_outcome({0: "a", 1: "b"}, {0: 1.0, 1: -1.0})
+        p.record_outcome({0: "a", 1: "b"}, {0: -1.0, 1: 1.0})
+        p.record_outcome({0: "a", 1: "b"}, {0: 0.0, 1: 0.0})
+        p.record_outcome({0: "a", 1: "b"}, {0: 0.0, 1: 0.0})
+        assert p.games("a", "b") == p.games("b", "a") == 4
+        # wp_func convention: (1 win + 2 draws/2) / 4
+        assert p.win_points("a", "b") == pytest.approx(0.5)
+        assert p.win_points("b", "a") == pytest.approx(0.5)
+        assert p.matches == 4
+
+    def test_wp_matches_wp_func_convention(self):
+        """One ledger, one convention: the PayoffMatrix win points must be
+        numerically wp_func over the same outcomes (the tools share it)."""
+        from handyrl_tpu.runtime.evaluation import wp_func
+
+        rng = np.random.default_rng(0)
+        p = PayoffMatrix()
+        totals = {}
+        for _ in range(200):
+            o = float(rng.choice([-1.0, 0.0, 1.0]))
+            p.record_outcome({0: "x", 1: "y"}, {0: o, 1: -o})
+            totals[o] = totals.get(o, 0) + 1
+        assert p.win_points("x", "y") == pytest.approx(wp_func(totals))
+
+    def test_multiplayer_placements_decompose_pairwise(self):
+        """A 4-player rank outcome (HungryGeese scores) records 6 pairwise
+        results: every seat beats every lower-ranked seat; ties draw."""
+        p = PayoffMatrix()
+        names = {0: "a", 1: "b", 2: "c", 3: "d"}
+        p.record_outcome(names, {0: 1.0, 1: 1 / 3, 2: -1 / 3, 3: -1.0})
+        assert p.win_points("a", "b") == 1.0
+        assert p.win_points("a", "d") == 1.0
+        assert p.win_points("c", "b") == 0.0
+        assert p.win_points("d", "a") == 0.0
+        p.record_outcome(names, {0: 0.5, 1: 0.5, 2: -1.0, 3: -1.0})
+        assert p.win_points("a", "b") == pytest.approx(0.75)   # win then draw
+        assert p.win_points("c", "d") == pytest.approx(0.75)   # win then tie
+        assert p.matches == 2
+
+    def test_same_member_both_seats_records_nothing(self):
+        p = PayoffMatrix()
+        p.record_outcome({0: "a", 1: "a"}, {0: 1.0, 1: -1.0})
+        assert p.games("a", "a") == 0
+        assert p.matches == 1   # the match still counts as played
+
+    def test_forfeit_only_severs_the_severed(self):
+        """Severed seat loses to every survivor; survivor pairs stay
+        unrecorded (their game never finished)."""
+        p = PayoffMatrix()
+        names = {0: "a", 1: "b", 2: "c"}
+        p.record_forfeit(names, 1)
+        assert p.win_points("a", "b") == 1.0
+        assert p.win_points("c", "b") == 1.0
+        assert p.win_points("b", "a") == 0.0
+        assert p.games("a", "c") == 0
+        assert p.forfeits == 1
+
+    def test_aggregate_is_game_weighted(self):
+        p = PayoffMatrix()
+        for _ in range(9):
+            p.record_score("a", "x", 1.0, -1.0)
+        p.record_score("a", "y", -1.0, 1.0)
+        assert p.aggregate_win_points("a", ["x", "y"]) == pytest.approx(0.9)
+
+    def test_roundtrip_and_adopt(self):
+        p = PayoffMatrix()
+        p.record_score(CANDIDATE, "x", 1.0, -1.0)
+        q = PayoffMatrix.from_dict(p.to_dict())
+        assert q.win_points(CANDIDATE, "x") == 1.0
+        q.adopt(CANDIDATE, "main-3")
+        assert q.win_points("main-3", "x") == 1.0
+        assert q.win_points(CANDIDATE, "x") is None
+        assert q.win_points("x", "main-3") == 0.0
+
+    def test_elo_orders_and_anchors(self):
+        p = PayoffMatrix()
+        for _ in range(20):
+            p.record_score("strong", ANCHOR, 1.0, -1.0)
+            p.record_score("weak", ANCHOR, -1.0, 1.0)
+        elo = p.elo(["strong", "weak", ANCHOR], anchor=ANCHOR)
+        assert elo[ANCHOR] == 0.0
+        assert elo["strong"] > 0 > elo["weak"]
+
+
+class TestPFSP:
+    def test_weightings(self):
+        assert pfsp_weights([0.5], "var")[0] == pytest.approx(0.25)
+        assert pfsp_weights([1.0], "hard")[0] == pytest.approx(1e-3)  # floored
+        assert pfsp_weights([0.0], "hard")[0] == pytest.approx(1.0)
+        assert pfsp_weights([0.2, 0.9], "even") == [1.0, 1.0]
+        # unplayed -> 0.5, the max of var weighting: new members get probed
+        w = pfsp_weights([None, 0.95], "var")
+        assert w[0] > w[1]
+        with pytest.raises(ValueError):
+            pfsp_weights([0.5], "nope")
+
+    def test_matchmaker_prefers_near_peers(self):
+        p = PayoffMatrix()
+        for _ in range(50):
+            p.record_score(CANDIDATE, "solved", 1.0, -1.0)   # p = 1.0
+            p.record_score(CANDIDATE, "peer", 1.0, -1.0)
+            p.record_score(CANDIDATE, "peer", -1.0, 1.0)     # p = 0.5
+        mm = Matchmaker(p, "var", seed=1)
+        draws = [mm.sample_opponent(CANDIDATE, ["solved", "peer"]) for _ in range(300)]
+        assert draws.count("peer") > 0.9 * len(draws)
+        assert mm.sample_opponent(CANDIDATE, []) is None
+
+    def test_probe_quota_prevents_starvation(self):
+        """One decisive game must not starve a member forever: below
+        min_games the sampler probes uniformly, so the coverage half of
+        the promotion gate is always reachable (the bug class: p=1.0
+        after a single win floors the 'var' weight)."""
+        p = PayoffMatrix()
+        p.record_score(CANDIDATE, "anchor", 1.0, -1.0)      # p pinned at 1.0
+        for _ in range(50):
+            p.record_score(CANDIDATE, "peer", 1.0, -1.0)
+            p.record_score(CANDIDATE, "peer", -1.0, 1.0)
+        mm = Matchmaker(p, "var", seed=2)
+        draws = [
+            mm.sample_opponent(CANDIDATE, ["anchor", "peer"], min_games=3)
+            for _ in range(50)
+        ]
+        assert draws.count("anchor") == 50                   # under quota: probed
+        # once the quota is met, PFSP takes over again
+        p.record_score(CANDIDATE, "anchor", 1.0, -1.0)
+        p.record_score(CANDIDATE, "anchor", 1.0, -1.0)
+        draws = [
+            mm.sample_opponent(CANDIDATE, ["anchor", "peer"], min_games=3)
+            for _ in range(200)
+        ]
+        # smoothing keeps the 3-0 anchor sampled occasionally (p pulled
+        # toward 0.5), but the near-peer dominates the draw
+        assert draws.count("peer") > draws.count("anchor")
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+class TestLeagueRegistry:
+    def test_fresh_league_seeds_anchor(self, tmp_path):
+        lg = League(str(tmp_path))
+        assert ANCHOR in lg.members
+        assert lg.members[ANCHOR].role == "anchor"
+        assert [m.name for m in lg.opponent_pool()] == [ANCHOR]
+
+    def test_freeze_persist_resume(self, tmp_path):
+        lg = League(str(tmp_path))
+        lg.payoff.record_score(CANDIDATE, ANCHOR, 1.0, -1.0)
+        lg.freeze_candidate(3, steps=123)
+        lg2 = League(str(tmp_path))
+        assert set(lg2.members) == {ANCHOR, "main-3"}
+        assert lg2.promotions == 1
+        # the candidate's books moved to the frozen name and persisted
+        assert lg2.payoff.win_points("main-3", ANCHOR) == 1.0
+        assert lg2.frozen_epochs() == [3]
+
+    def test_load_drops_unverifiable_member(self, tmp_path, capsys):
+        from handyrl_tpu.runtime.checkpoint import record_snapshot
+
+        lg = League(str(tmp_path))
+        lg.add("main-7", 7)
+        lg.save()
+        # manifest records epoch 7 but the snapshot bytes are wrong
+        (tmp_path / "7.ckpt").write_bytes(b"corrupt")
+        record_snapshot(str(tmp_path), 7, 1, {"7.ckpt": (0xDEAD, 999)})
+        lg2 = League(str(tmp_path))
+        assert "main-7" not in lg2.members
+        assert "digest" in capsys.readouterr().out
+
+    def test_unreadable_registry_fails_loudly(self, tmp_path):
+        """An EXISTING but unreadable LEAGUE.json must refuse to start a
+        fresh league: an empty registry empties the GC pin set and the
+        next gc_snapshots pass would permanently delete the frozen
+        members' snapshots.  Only a MISSING file means fresh."""
+        lg = League(str(tmp_path))
+        lg.add("main-2", 2)
+        lg.save()
+        path = tmp_path / "LEAGUE.json"
+        saved = path.read_bytes()
+        # a directory at the registry path: open() raises IsADirectoryError
+        # (an OSError that is not FileNotFoundError) for ANY uid — chmod
+        # tricks don't block root, which CI may run as
+        path.unlink()
+        path.mkdir()
+        try:
+            with pytest.raises(RuntimeError, match="cannot be read"):
+                League(str(tmp_path))
+        finally:
+            path.rmdir()
+            path.write_bytes(saved)
+        assert "main-2" in League(str(tmp_path)).members
+
+    def test_non_owner_never_writes(self, tmp_path):
+        """Coordinator-only registry ownership (the checkpoint
+        discipline): a non-owner league keeps its in-memory state but
+        save() is a no-op."""
+        lg = League(str(tmp_path))
+        lg.owner = False
+        lg.add("main-1", 1)
+        lg.save()
+        assert not (tmp_path / "LEAGUE.json").exists()
+
+    def test_pool_caps_but_keeps_anchor_and_newest(self, tmp_path):
+        lg = League(str(tmp_path), {"max_population": 3})
+        for epoch in (1, 2, 3, 4):
+            lg.add(f"main-{epoch}", epoch)
+        pool = [m.name for m in lg.opponent_pool()]
+        assert pool == [ANCHOR, "main-3", "main-4"]
+        # retired members' snapshots stay pinned for the books
+        assert lg.frozen_epochs() == [1, 2, 3, 4]
+
+    def test_reserved_and_duplicate_names_refused(self, tmp_path):
+        lg = League(str(tmp_path))
+        with pytest.raises(ValueError, match="reserved"):
+            lg.add(CANDIDATE, 5)
+        lg.add("main-5", 5)
+        with pytest.raises(ValueError, match="already"):
+            lg.add("main-5", 5)
+        with pytest.raises(ValueError, match="role"):
+            lg.add("weird", 6, role="boss")
+
+
+def test_learner_gc_call_sites_all_pass_pin():
+    """EVERY gc_snapshots call in the learner must carry the pin set —
+    the epoch-boundary call and the preemption-drain call alike: a
+    SIGTERM drain that GCs without pins would permanently delete frozen
+    population members' snapshots (found in review)."""
+    import ast
+    import inspect
+
+    from handyrl_tpu.runtime import learner as learner_mod
+
+    tree = ast.parse(inspect.getsource(learner_mod))
+    calls = [
+        node for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and getattr(node.func, "id", getattr(node.func, "attr", None))
+        == "gc_snapshots"
+    ]
+    assert calls, "expected gc_snapshots call sites in runtime/learner.py"
+    for call in calls:
+        assert any(kw.arg == "pin" for kw in call.keywords), (
+            f"gc_snapshots call at line {call.lineno} without pin="
+        )
+
+
+def test_gc_snapshots_pins_league_epochs(tmp_path):
+    """keep_checkpoints GC must never collect a frozen member's snapshot:
+    the pin parameter (fed by LeagueLearner._gc_pinned) exempts them."""
+    from handyrl_tpu.runtime.checkpoint import gc_snapshots
+
+    for e in range(1, 8):
+        (tmp_path / f"{e}.ckpt").write_bytes(b"x" * 8)
+    removed = gc_snapshots(str(tmp_path), keep=2, pin=(3, 4))
+    assert set(removed) == {1, 2, 5}
+    assert sorted(int(p.name.split(".")[0]) for p in tmp_path.glob("*.ckpt")) == [3, 4, 6, 7]
+
+
+# ---------------------------------------------------------------------------
+# learner integration
+# ---------------------------------------------------------------------------
+
+
+def _league_cfg(tmp_path, **train_overrides):
+    train = {
+        "batch_size": 8,
+        "forward_steps": 4,
+        "update_episodes": 8,
+        "minimum_episodes": 8,
+        "maximum_episodes": 500,
+        "num_batchers": 0,
+        "batch_pipeline": "thread",
+        "epochs": 2,
+        "eval_rate": 0.0,
+        "worker": {"num_parallel": 2},
+        "metrics_path": os.path.join(str(tmp_path), "metrics.jsonl"),
+        "model_dir": os.path.join(str(tmp_path), "models"),
+        "league": {"promote_winrate": 0.52, "promote_games": 4,
+                   "selfplay_rate": 0.25},
+    }
+    train.update(train_overrides)
+    return normalize_args({"env_args": {"env": "TicTacToe"}, "train_args": train})
+
+
+def test_league_learner_assigns_pfsp_matches(tmp_path):
+    """Role assignment: with a frozen pool, generation jobs split between
+    pure self-play and candidate-vs-member matches with rotated seats and
+    the member's epoch stamped on the opponent seats."""
+    from handyrl_tpu.league.learner import LeagueLearner
+
+    cfg = _league_cfg(tmp_path)
+    learner = LeagueLearner(cfg)
+    try:
+        learner.league.add("main-0", 0)  # epoch-0 member: no snapshot needed
+        learner.model_epoch = 1          # pretend one epoch trained
+        modes = {"selfplay": 0, "match": 0}
+        seats_seen = set()
+        for _ in range(600):
+            args = learner._assign_role()
+            if args["role"] != "g":
+                # the effective eval-rate floor (update_episodes**-0.15)
+                # interleaves eval jobs; league changes only 'g' jobs
+                assert "league" not in args
+                continue
+            meta = args["league"]
+            modes[meta["mode"]] += 1
+            if meta["mode"] == "match":
+                cand = [p for p, n in meta["seats"].items() if n == CANDIDATE]
+                assert len(cand) == 1
+                assert args["player"] == cand
+                seats_seen.add(cand[0])
+                for p, name in meta["seats"].items():
+                    want = 1 if name == CANDIDATE else 0
+                    assert args["model_id"][p] == want
+        assert modes["match"] > modes["selfplay"] > 0
+        assert seats_seen == {0, 1}      # first/second balanced
+    finally:
+        learner.model_server.stop()
+        learner.trainer.stop()
+
+
+def test_league_feed_masks_opponent_and_records_payoff(tmp_path):
+    """feed_episodes on a league match must (a) record the pairwise
+    outcome under the seat names and (b) zero the opponent's tmask/omask
+    so only the candidate's steps train."""
+    from handyrl_tpu.league.learner import LeagueLearner
+    from handyrl_tpu.runtime.replay import compress_block, decompress_block
+
+    cfg = _league_cfg(tmp_path)
+    learner = LeagueLearner(cfg)
+    try:
+        T, P, A = 4, 2, 9
+        cols = {
+            "obs": np.ones((T, P, 3, 3, 3), np.float32),
+            "prob": np.full((T, P), 0.5, np.float32),
+            "action": np.zeros((T, P), np.int32),
+            "amask": np.zeros((T, P, A), np.float32),
+            "value": np.ones((T, P), np.float32),
+            "reward": np.zeros((T, P), np.float32),
+            "ret": np.zeros((T, P), np.float32),
+            "tmask": np.ones((T, P), np.float32),
+            "omask": np.ones((T, P), np.float32),
+            "turn": np.zeros(T, np.int32),
+        }
+        episode = {
+            "args": {
+                "player": [1],
+                "model_id": {0: 0, 1: 1},
+                "league": {"mode": "match",
+                           "seats": {0: "main-0", 1: CANDIDATE}},
+            },
+            "steps": T,
+            "players": [0, 1],
+            "outcome": {0: -1.0, 1: 1.0},
+            "blocks": [compress_block(cols)],
+        }
+        learner.feed_episodes([episode, None])
+        assert learner.league.payoff.win_points(CANDIDATE, "main-0") == 1.0
+        assert learner.league.payoff.win_points("main-0", CANDIDATE) == 0.0
+        out = decompress_block(episode["blocks"][0])
+        assert out["tmask"][:, 1].tolist() == [1.0] * T       # candidate kept
+        assert out["tmask"][:, 0].tolist() == [0.0] * T       # opponent zeroed
+        assert out["omask"][:, 0].tolist() == [0.0] * T
+        assert out["prob"][:, 0].tolist() == [0.5] * T        # data intact
+    finally:
+        learner.model_server.stop()
+        learner.trainer.stop()
+
+
+def test_league_model_server_routes_frozen_through_router(tmp_path, monkeypatch):
+    """Frozen epochs resolve to resident router engines (one disk load,
+    reused), latest keeps the shared engine, id 0 stays the RandomModel,
+    and a missing snapshot substitutes latest COUNTED."""
+    import jax
+
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.league.learner import LeagueModelServer, RouterOpponent
+    from handyrl_tpu.models import init_variables
+    from handyrl_tpu.runtime.checkpoint import save_epoch_snapshot
+
+    monkeypatch.chdir(tmp_path)
+    cfg = _league_cfg(tmp_path)
+    env = make_env({"env": "TicTacToe"})
+    module = env.net()
+    variables = init_variables(module, env)
+    args = dict(cfg["train_args"])
+    args["model_dir"] = str(tmp_path / "models")
+    server = LeagueModelServer(module, env, args)
+    # the whole active pool must stay resident (+1 for the pinned latest):
+    # the serving default max_models=4 would thrash evict/cold-reload
+    assert server._router.max_models >= args["league"]["max_population"] + 1
+    try:
+        params = variables["params"]
+        save_epoch_snapshot(args["model_dir"], 1, params, {"note": 1}, 1)
+        server.publish(1, params)
+        server.publish(2, params)
+        assert isinstance(server.get(1), RouterOpponent)
+        env.reset()
+        obs = env.observation(0)
+        out = server.get(1).inference(obs)
+        assert np.shape(np.asarray(out["policy"]))[-1] == 9
+        assert 1 in server._router.routes()
+        # latest (>= current) keeps the shared engine; 0 is random
+        assert not isinstance(server.get(2), RouterOpponent)
+        assert server.get(0) is server._random
+        # a GC'd epoch substitutes latest, counted
+        before = server.substituted_snapshots
+        out = server.get(1)           # resident: no substitution
+        out.inference(obs)
+        missing = RouterOpponent(server, 1)
+        # drop the snapshot file, evict the resident engine, re-resolve
+        os.unlink(os.path.join(args["model_dir"], "1.ckpt"))
+        server._router._engines.pop(1).stop()
+        missing.inference(obs)
+        assert server.substituted_snapshots == before + 1
+    finally:
+        server.stop()
+
+
+def test_league_learner_refuses_future_members(tmp_path):
+    """A league whose members reference epochs newer than the resumed
+    model must fail loudly at startup (those matches would silently run
+    against LATEST params and poison the books)."""
+    from handyrl_tpu.league.learner import LeagueLearner
+
+    cfg = _league_cfg(tmp_path)
+    lg = League(os.path.join(str(tmp_path), "models"))
+    lg.add("main-9", 9)
+    lg.save()
+    with pytest.raises(ValueError, match="main-9"):
+        LeagueLearner(cfg)
+
+
+def test_league_end_to_end(tmp_path, monkeypatch):
+    """ISSUE 11 acceptance: a TicTacToe league on the virtual CPU mesh
+    grows a >=3-member population (anchor + >=2 frozen) through the
+    promotion gate, the payoff matrix fills for every active pair, and
+    league_* metrics land in metrics.jsonl."""
+    from handyrl_tpu.league.learner import LeagueLearner
+
+    monkeypatch.chdir(tmp_path)
+    # the bar sits below the random-vs-random seat-balanced wp (~0.5) so
+    # the GATE MECHANICS (coverage requirement, freeze, books hand-off,
+    # GC pin) are what this run exercises within a CI-sized epoch budget
+    # — candidate strength vs the bar is the league soak's concern
+    cfg = _league_cfg(
+        tmp_path,
+        epochs=8,
+        update_episodes=24,
+        minimum_episodes=16,
+        league={"promote_winrate": 0.4, "promote_games": 3,
+                "selfplay_rate": 0.15, "pfsp_weighting": "var"},
+    )
+    learner = LeagueLearner(cfg)
+    assert learner.run() == 0
+
+    # population: anchor + >=2 promoted members
+    members = learner.league.members
+    frozen = [m for m in members.values() if m.role == "frozen"]
+    assert len(members) >= 3, sorted(members)
+    assert len(frozen) >= 2, sorted(members)
+    assert learner.league.promotions >= 2
+
+    # payoff coverage: the matrix filled for every pair ACTIVE at each
+    # generation — a member frozen at epoch K inherited the candidate's
+    # books, which the gate required to cover the whole pool of its time
+    # (the anchor + every earlier-frozen member)
+    payoff = learner.league.payoff
+    for i, m in enumerate(sorted(frozen, key=lambda m: m.epoch)):
+        earlier = [ANCHOR] + [
+            x.name for x in sorted(frozen, key=lambda m: m.epoch)[:i]
+        ]
+        assert payoff.coverage(m.name, earlier) == 1.0, (m.name, earlier)
+        assert all(payoff.games(m.name, b) >= 3 for b in earlier)
+
+    # the league persisted and re-loads with books intact
+    lg2 = League(os.path.join(str(tmp_path), "models"))
+    assert set(lg2.members) == set(members)
+    assert lg2.payoff.matches == payoff.matches
+
+    # league_* metrics in metrics.jsonl
+    records = [json.loads(l) for l in open(cfg["train_args"]["metrics_path"])]
+    assert records
+    last = records[-1]
+    for key in ("league_population", "league_pool", "league_matches",
+                "league_payoff_coverage", "league_promotions"):
+        assert key in last, key
+    assert last["league_population"] >= 3
+    assert last["league_matches"] > 0
+    assert last["league_promotions"] >= 2
+    assert any(r.get("league_elo_spread") is not None for r in records)

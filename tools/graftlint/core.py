@@ -217,6 +217,9 @@ class LintConfig:
         # the serving plane's request loop is a latency hot path: one
         # stray per-batch host sync is a p99 regression on every model
         "handyrl_tpu/serving/*.py",
+        # the league plane sits inside the learner's epoch/feed loops and
+        # the actors' match loop: a host sync here stalls generation
+        "handyrl_tpu/league/*.py",
     )
     # functions (bare names) that are drain/teardown/construction paths —
     # host syncs there are the POINT, not a leak
@@ -243,6 +246,9 @@ class LintConfig:
         # located, with a training plane): every engine dispatch must hold
         # its explicit device scope
         "handyrl_tpu/serving/*.py",
+        # league opponent engines co-reside with the training plane (and
+        # each other) on the same chips — same invariant as serving
+        "handyrl_tpu/league/*.py",
     )
     dispatch_wrapper: str = "dispatch_serialized"
 
@@ -251,7 +257,9 @@ class LintConfig:
     cfg005_docs: str = "docs/parameters.md"
     # dict-valued defaults whose CHILDREN are the knobs (worker.entry_port);
     # every other dict-valued default (mesh, ...) is one knob
-    cfg005_nested: Tuple[str, ...] = ("worker", "distributed", "eval", "serving")
+    cfg005_nested: Tuple[str, ...] = (
+        "worker", "distributed", "eval", "serving", "league",
+    )
     # documented spellings that are intentionally not defaults (aliases
     # normalized away before validation)
     cfg005_doc_aliases: Tuple[str, ...] = ("attn_mode",)
@@ -262,6 +270,7 @@ class LintConfig:
         "handyrl_tpu/runtime/learner.py",
         "handyrl_tpu/runtime/trainer.py",
         "handyrl_tpu/serving/server.py",
+        "handyrl_tpu/league/learner.py",
     )
     # module-level *_KEYS tuples that feed metrics keys, with the prefix
     # they are written under

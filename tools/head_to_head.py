@@ -203,7 +203,13 @@ def _load_ours_agent(run_dir: str, temperature: float):
 
 def pit(ref_dir: str, ours_dir: str, games: int, temperature: float) -> dict:
     """Seat-balanced direct match through this repo's match layer; returns
-    the result dict with win points from OUR agent's perspective."""
+    the result dict with win points from OUR agent's perspective.
+
+    Results land in a league ``PayoffMatrix`` (handyrl_tpu/league) — the
+    same ledger league matches and battle-server games record into — so
+    this tool, the league's promotion gate, and the sampler ablation all
+    report ONE win-points convention (win + draw/2 over games, wp_func)."""
+    from handyrl_tpu.league.matchmaker import PayoffMatrix
     from handyrl_tpu.runtime.evaluation import evaluate_mp, wp_func
 
     ours = _load_ours_agent(ours_dir, temperature)
@@ -211,20 +217,28 @@ def pit(ref_dir: str, ours_dir: str, games: int, temperature: float) -> dict:
     results = evaluate_mp(
         {"env": "TicTacToe"}, {0: ours, 1: ref}, games, num_workers=2
     )
-    total: dict = {}
+    payoff = PayoffMatrix()
     per_pattern = {}
+    outcomes_total: dict = {}
     for pat, res in results.items():
+        for outcome, count in res.items():
+            # evaluate_mp aggregates outcomes from OUR seat's perspective;
+            # replay them into the ledger pairwise (zero-sum 2p)
+            payoff.record_score("ours", "ref", float(outcome), -float(outcome),
+                                n=count)
+            outcomes_total[outcome] = outcomes_total.get(outcome, 0) + count
         per_pattern[pat] = {
             "win_points": round(wp_func(res), 4),
             "games": sum(res.values()),
             "outcomes": {str(k): v for k, v in res.items()},
         }
-        for k, v in res.items():
-            total[k] = total.get(k, 0) + v
+    wp = payoff.win_points("ours", "ref")
     return {
-        "ours_win_points": round(wp_func(total), 4),
-        "games": sum(total.values()),
-        "outcomes_from_ours_perspective": {str(k): v for k, v in total.items()},
+        "ours_win_points": None if wp is None else round(wp, 4),
+        "games": payoff.games("ours", "ref"),
+        "outcomes_from_ours_perspective": {
+            str(k): v for k, v in outcomes_total.items()
+        },
         "per_pattern": per_pattern,
     }
 
