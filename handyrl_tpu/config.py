@@ -106,11 +106,25 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     "mesh": {"dp": -1},
     # multi-host learner plane (parallel/distributed.py): set
     # coordinator_address ("host:port" of process 0) + num_processes (+
-    # process_id or PROCESS_ID env) to span hosts with jax.distributed
+    # process_id or PROCESS_ID env) to span hosts with jax.distributed.
+    # initialization_timeout bounds startup against a dead/mis-addressed
+    # coordinator (loud error, never a hang); the heartbeat/collective
+    # knobs drive the cross-host health plane (parallel/health.py): a
+    # lost or wedged peer is detected within heartbeat_timeout (or
+    # collective_timeout for a silent wedge), the coordinator drain-saves
+    # a verified checkpoint, and every survivor exits 75 for a
+    # restart_epoch: -1 relaunch instead of hanging in a dead collective
     "distributed": {
         "coordinator_address": None,
         "num_processes": 1,
         "process_id": None,
+        "initialization_timeout": 300.0,
+        "heartbeat_interval": 5.0,
+        "heartbeat_timeout": 30.0,
+        "collective_timeout": 300.0,
+        # health plane's TCP port on the coordinator host (0 = derive:
+        # coordinator port + 1)
+        "health_port": 0,
     },
     "inference_batch_size": 64,
     "prefetch_batches": 2,
@@ -378,6 +392,95 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError("train_args.plane_param_lag_bound must be >= 0 (0 = off)")
     if train["drain_deadline_seconds"] <= 0:
         raise ValueError("train_args.drain_deadline_seconds must be > 0")
+    dist = train["distributed"]
+    if dist["coordinator_address"] is not None:
+        # both the init pre-flight (parallel/distributed.py) and the health
+        # plane (parallel/health.py) parse host:port out of this — a
+        # missing port must fail HERE with a named knob, not as a bare
+        # int() traceback inside a socket helper
+        _host, _, _port = str(dist["coordinator_address"]).rpartition(":")
+        if not _host or not _port.isdigit() or not 1 <= int(_port) <= 65535:
+            raise ValueError(
+                f"train_args.distributed.coordinator_address="
+                f"{dist['coordinator_address']!r} must be 'host:port' with a "
+                "TCP port (the address of process 0)"
+            )
+    if int(dist["num_processes"]) < 1:
+        raise ValueError("train_args.distributed.num_processes must be >= 1")
+    if dist["process_id"] is not None and int(dist["process_id"]) < 0:
+        raise ValueError("train_args.distributed.process_id must be >= 0")
+    if float(dist["initialization_timeout"]) <= 0:
+        raise ValueError(
+            "train_args.distributed.initialization_timeout must be > 0 "
+            "(it bounds jax.distributed.initialize against a dead or "
+            "mis-addressed coordinator — 0 would restore the indefinite "
+            "startup hang)"
+        )
+    if float(dist["heartbeat_interval"]) < 0:
+        raise ValueError(
+            "train_args.distributed.heartbeat_interval must be >= 0 "
+            "(0 disables the cross-host health plane)"
+        )
+    if float(dist["heartbeat_timeout"]) <= 0:
+        raise ValueError("train_args.distributed.heartbeat_timeout must be > 0")
+    if (
+        float(dist["heartbeat_interval"]) > 0
+        and float(dist["heartbeat_timeout"]) <= 2 * float(dist["heartbeat_interval"])
+    ):
+        raise ValueError(
+            "train_args.distributed.heartbeat_timeout must exceed 2x "
+            "heartbeat_interval — a single delayed beat must not count a "
+            "live host as lost"
+        )
+    if float(dist["collective_timeout"]) < 0:
+        raise ValueError(
+            "train_args.distributed.collective_timeout must be >= 0 "
+            "(0 disables the collective watchdog)"
+        )
+    if not isinstance(dist["health_port"], int) or not 0 <= dist["health_port"] <= 65535:
+        raise ValueError(
+            f"train_args.distributed.health_port={dist['health_port']!r} "
+            "must be a TCP port (0 = coordinator port + 1)"
+        )
+    if (
+        dist["health_port"] == 0
+        and dist["coordinator_address"] is not None
+        and float(dist["heartbeat_interval"]) > 0  # plane enabled at all
+        and int(str(dist["coordinator_address"]).rpartition(":")[2]) >= 65535
+    ):
+        raise ValueError(
+            "train_args.distributed.health_port derives as coordinator "
+            "port + 1 = 65536, which is not a TCP port — set "
+            "distributed.health_port explicitly"
+        )
+    # the distributed plane only ACTIVATES with a coordinator_address
+    # (init_distributed returns 0 without one — num_processes alone may
+    # just be a fleet template), so the per-process-local rejections key
+    # on both
+    if int(dist["num_processes"]) > 1 and dist["coordinator_address"]:
+        if train["device_replay"]:
+            raise ValueError(
+                "train_args.device_replay is not supported under a multi-"
+                "process jax.distributed run yet (the device rings and the "
+                "sampling RNG are per-process; the collective train step "
+                "needs every process sampling the same global windows) — "
+                "use the host batch pipelines"
+            )
+        if train["plane"] == "split":
+            raise ValueError(
+                "train_args.plane: split is not supported under a multi-"
+                "process jax.distributed run yet (the actor/learner mesh "
+                "carve is per-process-local) — use plane: fused"
+            )
+        if train["device_rollout_games"] > 0:
+            raise ValueError(
+                "train_args.device_rollout_games > 0 is not supported under "
+                "a multi-process jax.distributed run yet (the sharded device "
+                "rollout dispatches device programs outside the coordinator "
+                "cadence — racing the lockstep collectives — and its "
+                "sampling RNG is not rank-decorrelated, so every process "
+                "would generate identical episodes) — use host self-play"
+            )
     if train["worker"]["heartbeat_interval"] < 0:
         raise ValueError("train_args.worker.heartbeat_interval must be >= 0 (0 = off)")
     for key in ("socket_timeout", "entry_timeout"):

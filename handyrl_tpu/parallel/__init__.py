@@ -12,11 +12,15 @@ from .train_step import (
     resolve_seq_remat,
 )
 from .distributed import (
+    DistributedCadence,
+    broadcast_resume_epoch,
     init_distributed,
     is_coordinator,
     local_batch_size,
     process_count,
+    process_index,
 )
+from .health import CollectiveWatchdog, HostHealthPlane
 
 __all__ = [
     "make_mesh",
@@ -32,4 +36,9 @@ __all__ = [
     "is_coordinator",
     "local_batch_size",
     "process_count",
+    "process_index",
+    "DistributedCadence",
+    "broadcast_resume_epoch",
+    "CollectiveWatchdog",
+    "HostHealthPlane",
 ]

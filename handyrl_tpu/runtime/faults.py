@@ -28,6 +28,18 @@ Hooks:
 * ``HANDYRL_FAULT_SIGTERM_AT_STEP="N"`` — the trainer delivers SIGTERM
   to its own process once the step counter reaches N (mid-epoch, the
   way a TPU-VM preemption lands), driving the preemption-safe drain.
+* ``HANDYRL_FAULT_KILL_PROCESS_AT_EPOCH="E:R"`` (or bare ``"E"`` = rank
+  0) — the jax.distributed process with index R dies hard
+  (``os._exit``) the moment its model epoch reaches E, simulating a
+  lost host mid-run.  The survivors must detect the loss through the
+  cross-host health plane (parallel/health.py) within the configured
+  bound, drain-save on the coordinator, and exit 75 — the host-loss
+  e2e in tests/test_multihost.py.
+* ``HANDYRL_FAULT_WEDGE_PROCESS="E:R"`` (or bare ``"E"``) — the same
+  trigger, but instead of dying the process FREEZES: heartbeats stop,
+  the trainer stops joining collectives, threads spin without progress
+  (a wedged-but-not-dead host).  Survivors must escape through the
+  heartbeat timeout or the collective watchdog, never hang.
 """
 
 from __future__ import annotations
@@ -72,3 +84,29 @@ def sigterm_at_step() -> Optional[int]:
     """Absolute SGD step at which the trainer SIGTERMs its own process."""
     raw = _get("HANDYRL_FAULT_SIGTERM_AT_STEP")
     return None if raw is None else int(raw)
+
+
+def _epoch_rank(name: str) -> Optional[Tuple[int, int]]:
+    """Parse an ``"E:R"`` (epoch, rank) injection; bare ``"E"`` = rank 0.
+    Malformed values raise immediately — a typo'd injection silently doing
+    nothing would fake a green host-loss e2e."""
+    raw = _get(name)
+    if raw is None:
+        return None
+    epoch, _, rank = raw.partition(":")
+    try:
+        return int(epoch), int(rank) if rank else 0
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r}: expected 'EPOCH' or 'EPOCH:RANK' (ints)"
+        ) from None
+
+
+def kill_process_at_epoch() -> Optional[Tuple[int, int]]:
+    """(epoch, rank) at which that jax.distributed process dies hard."""
+    return _epoch_rank("HANDYRL_FAULT_KILL_PROCESS_AT_EPOCH")
+
+
+def wedge_process_at_epoch() -> Optional[Tuple[int, int]]:
+    """(epoch, rank) at which that process freezes (silent, not dead)."""
+    return _epoch_rank("HANDYRL_FAULT_WEDGE_PROCESS")

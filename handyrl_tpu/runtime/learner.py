@@ -68,7 +68,30 @@ class Learner:
         train_args = dict(args["train_args"])
         train_args["env"] = args["env_args"]
         self.args = train_args
-        random.seed(self.args["seed"])
+
+        # -- multi-process role (parallel/distributed.py) -----------------
+        # jax.distributed must already be initialized by the entry point
+        # (main.py calls init_distributed before constructing the Learner);
+        # single-process runs see nprocs == 1 and none of the distributed
+        # machinery below activates.
+        import jax
+
+        from ..parallel.distributed import process_index
+
+        self._dist_nprocs = jax.process_count()
+        self._dist_rank = process_index() if self._dist_nprocs > 1 else 0
+        self._dist_follower = self._dist_nprocs > 1 and not is_coordinator()
+        # generation diversity: each process contributes DIFFERENT episodes
+        # to the global batch (the model-init seed stays the base seed on
+        # every process — params must start identical everywhere)
+        random.seed(self.args["seed"] + 1009 * self._dist_rank)
+        # host-loss fault injections (runtime/faults.py), parsed here so
+        # tests set the env right before construction; malformed = loud
+        self._fault_kill_proc = faults.kill_process_at_epoch()
+        self._fault_wedge_proc = faults.wedge_process_at_epoch()
+        self._health = None
+        self._collective_watchdog = None
+        self._host_faulted = False
 
         prepare_env(args["env_args"])
         self.env = make_env(args["env_args"])
@@ -87,26 +110,21 @@ class Learner:
             # auto-resume: newest manifest entry whose snapshot digest
             # still verifies, falling back to older verified epochs when a
             # crash or bit-rot corrupted the newest one (0 = fresh start)
-            import jax
-
-            if jax.process_count() > 1:
+            if self._dist_nprocs > 1:
                 # every SPMD process must resume the SAME epoch, and only
                 # the coordinator writes checkpoints — so only IT scans
                 # (the digest sweep can stream many GB; N-1 redundant
                 # sweeps of a shared filesystem would all be discarded)
-                # and broadcasts its verdict.  On a NON-shared model_dir
-                # the other processes then fail LOUDLY below
+                # and broadcasts its verdict (parallel/distributed.py,
+                # pinned by the 2-process resume test).  On a NON-shared
+                # model_dir the other processes then fail LOUDLY below
                 # (load_verified_params can't find the file) instead of
                 # silently feeding fresh seed params into the collective
                 # train step, exactly like an explicit restart_epoch.
-                from jax.experimental import multihost_utils
-
-                import numpy as np
+                from ..parallel.distributed import broadcast_resume_epoch
 
                 local = latest_verified_epoch(self.model_dir) if is_coordinator() else 0
-                self.model_epoch = int(
-                    multihost_utils.broadcast_one_to_all(np.int32(local))
-                )
+                self.model_epoch = broadcast_resume_epoch(local)
                 # coordinator-verified, not locally verified, off process 0
                 auto_resumed = self.model_epoch > 0 and is_coordinator()
             else:
@@ -164,6 +182,48 @@ class Learner:
         else:
             mesh = make_mesh(self.args.get("mesh"))
         self.trainer = Trainer(self.args, self.module, params, mesh)
+        if self._dist_nprocs > 1:
+            # distributed epoch loop: the coordinator's boundary/shutdown/
+            # drain decisions reach every trainer as tiny broadcast
+            # collectives (parallel/distributed.py), and the health plane
+            # + collective watchdog bound a lost or wedged peer
+            # (parallel/health.py — started in run())
+            from ..parallel.distributed import DistributedCadence
+            from ..parallel.health import CollectiveWatchdog, HostHealthPlane
+
+            dist_args = dict(self.args.get("distributed") or {})
+            self.trainer.cadence = DistributedCadence(self.trainer.ctx.mesh)
+            timeout = float(dist_args.get("collective_timeout") or 0.0)
+            if timeout > 0:
+                self._collective_watchdog = CollectiveWatchdog(
+                    timeout,
+                    lambda reason: self._host_fault(reason, "collective_timeout"),
+                )
+                self.trainer.collective_watchdog = self._collective_watchdog
+            if dist_args.get("coordinator_address"):
+                self._health = HostHealthPlane(
+                    dist_args,
+                    self._dist_rank,
+                    self._dist_nprocs,
+                    lambda reason, kind: self._host_fault(reason, kind),
+                )
+            # the agreed stop/drain boundary reaches every rank in the same
+            # broadcast; from there peer silence is teardown, not a fault —
+            # run() teardown is too late (ranks skew by worker joins /
+            # final fetches, and the skewed rank would exit 75 out of a
+            # clean run)
+            self.trainer.on_agreed_finish = self._disarm_host_fault
+            print(
+                "distributed learner: process %d/%d (%s), health plane %s, "
+                "collective watchdog %s"
+                % (
+                    self._dist_rank,
+                    self._dist_nprocs,
+                    "coordinator" if not self._dist_follower else "follower",
+                    "on" if (self._health and self._health.enabled) else "off",
+                    f"{timeout:.0f}s" if timeout > 0 else "off",
+                )
+            )
         # the CONFIGURED assembly plane (start() hasn't run yet, so an shm
         # pipeline could still fall back to threads); metrics records read
         # the live mode from batcher.stats() at each epoch, which is the
@@ -555,6 +615,14 @@ class Learner:
             # degradation) + cumulative watchdog events
             record["plane"] = self._plane
             record.update(self._watchdog_events)
+        if self._dist_nprocs > 1:
+            # cross-host health (cumulative, like the other event
+            # counters): nonzero anywhere in the run means the plane saw
+            # trouble — the final pre-exit values ride the host-fault
+            # drain record instead, since a drained process never reaches
+            # another boundary
+            record["dist_processes"] = self._dist_nprocs
+            record.update(self._dist_events())
         # local refs: a concurrent watchdog degrade nulls these attributes
         # between the None-check and the reads (same hazard as
         # _actor_params) — the epoch record must not die on the very
@@ -590,6 +658,7 @@ class Learner:
     def update_model(self, params, steps: int) -> None:
         print("updated model(%d)" % steps)
         self.model_epoch += 1
+        self._dist_fault_hooks()
         if is_coordinator():
             # process-0 guard: under jax.distributed every process runs the
             # SPMD train step, but exactly one owns the checkpoint files.
@@ -740,8 +809,11 @@ class Learner:
         if not self._drain_stopped:
             self._drain_stopped = True
             # stop the trainer mid-epoch: its thread snapshots state_host
-            # on the way out, which becomes the drain checkpoint
-            self.trainer.stop()
+            # on the way out, which becomes the drain checkpoint.  Multi-
+            # process, this is cadence-aware (Trainer.request_drain): the
+            # coordinator broadcasts the DRAIN bit so every process ends
+            # the epoch together instead of wedging the peers mid-collective
+            self.trainer.request_drain()
         if time.time() - self._drain_t0 > self.drain_deadline:
             print(
                 "[handyrl_tpu] drain deadline exceeded; forcing shutdown "
@@ -770,6 +842,93 @@ class Learner:
             f"step {steps} (manifest-verified; resume with restart_epoch: -1)",
             file=sys.stderr,
         )
+
+    # -- cross-host fault handling (parallel/health.py) -----------------------
+
+    def _dist_events(self) -> Dict[str, int]:
+        """Cumulative cross-host health counters for the dist_* metrics."""
+        health_ev = self._health.events if self._health is not None else {}
+        return {
+            "dist_heartbeat_misses": int(health_ev.get("heartbeat_misses", 0)),
+            "dist_collective_timeouts": 1 if (
+                self._collective_watchdog is not None
+                and self._collective_watchdog.fired
+            ) else 0,
+            "dist_peer_loss_drains": int(health_ev.get("peer_losses", 0))
+            + int(health_ev.get("coordinator_losses", 0)),
+        }
+
+    def _disarm_host_fault(self) -> None:
+        """Called by the trainer the moment the agreed stop/drain broadcast
+        returns: every rank is past its last collective, so the detectors
+        must stand down before rank-skewed teardown starts."""
+        if self._health is not None:
+            self._health.disarm()
+        if self._collective_watchdog is not None:
+            self._collective_watchdog.stop()
+
+    def _host_fault(self, reason: str, kind: str) -> None:
+        """A peer process is lost or a collective wedged: runs on a health/
+        watchdog thread while the trainer may be stuck inside a collective
+        that can NEVER complete — no Python-level cancel exists for an
+        in-flight XLA collective, so the only bounded recovery is to
+        drain-save from the last consistent HOST snapshot (state_host is
+        swapped atomically at each epoch end and never device-resident)
+        and leave via os._exit: the normal interpreter teardown would
+        block on the wedged thread.  Exit code 75 (EX_TEMPFAIL) tells the
+        supervisor to relaunch every rank with restart_epoch: -1."""
+        from ..parallel.health import announce_fault
+
+        if self._host_faulted:
+            return
+        self._host_faulted = True
+        announce_fault(reason, kind, EXIT_RESUMABLE)
+        try:
+            if is_coordinator():
+                record = {"epoch": self.model_epoch, "dist_processes": self._dist_nprocs}
+                record.update(self._dist_events())
+                self._write_metrics(record)
+                self._write_drain_checkpoint()
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            print(
+                "[handyrl_tpu] host-fault drain save failed (above); the "
+                "previous epoch's verified checkpoint remains the resume "
+                "point",
+                file=sys.stderr,
+            )
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os._exit(EXIT_RESUMABLE)
+
+    def _dist_fault_hooks(self) -> None:
+        """Host-loss fault injections, checked at each epoch publish
+        (runtime/faults.py): rank-scoped hard kill / freeze."""
+        kill = self._fault_kill_proc
+        if kill is not None and self.model_epoch >= kill[0] and self._dist_rank == kill[1]:
+            print(
+                f"[fault] killing process rank {self._dist_rank} at epoch "
+                f"{self.model_epoch} (HANDYRL_FAULT_KILL_PROCESS_AT_EPOCH)",
+                file=sys.stderr,
+            )
+            sys.stderr.flush()
+            os._exit(1)
+        wedge = self._fault_wedge_proc
+        if wedge is not None and self.model_epoch >= wedge[0] and self._dist_rank == wedge[1]:
+            print(
+                f"[fault] wedging process rank {self._dist_rank} at epoch "
+                f"{self.model_epoch} (HANDYRL_FAULT_WEDGE_PROCESS): "
+                "heartbeats stop, collectives stop, threads stay up",
+                file=sys.stderr,
+            )
+            sys.stderr.flush()
+            if self._health is not None:
+                self._health.stop_heartbeats()
+            self.trainer._fault_wedge_process = True
+            while True:  # the frozen host never comes back
+                time.sleep(60.0)
 
     def server(self) -> None:
         print("started server")
@@ -837,15 +996,63 @@ class Learner:
             else:
                 fut.set_result(None)
 
-            if (
+            if self._dist_follower:
+                # coordinator-driven boundary: the trainer's queue only
+                # holds a snapshot once the coordinator ended the epoch on
+                # EVERY process (DistributedCadence); local episode counts
+                # play no cadence role on a follower
+                if self.trainer.drain_agreed and not self._drain_requested:
+                    # the coordinator broadcast a preemption drain: adopt
+                    # it locally so this rank also lands on EXIT_RESUMABLE
+                    self._drain_requested = True
+                    self._drain_t0 = time.time()
+                    self.shutdown_flag = True
+                    print(
+                        "[handyrl_tpu] coordinator-agreed drain: shutting "
+                        f"down within {self.drain_deadline:.0f}s and exiting "
+                        f"{EXIT_RESUMABLE} for the coordinated relaunch",
+                        file=sys.stderr,
+                    )
+                elif (
+                    not self._drain_requested
+                    and not self.trainer.update_queue.empty()
+                ):
+                    self.update()
+                elif (
+                    self.trainer.finished
+                    and self.trainer.update_queue.empty()
+                    and not self._drain_requested
+                ):
+                    # the stop was agreed through the cadence; the final
+                    # snapshot above has been consumed — drain the workers
+                    self.shutdown_flag = True
+            elif (
                 self.num_returned_episodes >= next_update_episodes
                 and not self._drain_requested  # draining: no new boundary work
             ):
                 prev_update_episodes = next_update_episodes
                 next_update_episodes = prev_update_episodes + self.args["update_episodes"]
                 self._next_update_episodes = next_update_episodes
+                if self._dist_nprocs > 1 and not self.trainer._warmed_up():
+                    # multi-process coordinator, PRE-WARMUP boundary:
+                    # followers only ever see AGREED epoch ends (their
+                    # boundary is the cadence snapshot), so counting an
+                    # epoch here would advance model_epoch on this rank
+                    # alone — desyncing the epochs-limit shutdown (the
+                    # stop is never broadcast pre-warmup) and the
+                    # rank-scoped "E:R" fault injections.  Defer it.
+                    continue
                 self.update()
-                if self.args["epochs"] >= 0 and self.model_epoch >= self.args["epochs"]:
+                shutdown = (
+                    self.args["epochs"] >= 0
+                    and self.model_epoch >= self.args["epochs"]
+                )
+                # multi-process coordinator: release the trainer's post-
+                # epoch handshake with the continue/shutdown decision so
+                # every process stops (or starts the next epoch) together;
+                # a no-op single-process and on pre-warmup boundaries
+                self.trainer.proceed(shutdown)
+                if shutdown:
                     self.shutdown_flag = True
         self.trainer.stop()
         self.model_server.stop()
@@ -862,11 +1069,15 @@ class Learner:
         if self._trainer_thread is not None:
             # under a drain, the join is bounded by what's left of the
             # deadline (floor 5s) so a wedged trainer can't eat the budget;
-            # the checkpoint then falls back to the last consistent state
-            timeout = 30.0
+            # the checkpoint then falls back to the last consistent state.
+            # Multi-process the bound is wider: the thread may still be
+            # inside the final agree_stop broadcast (waiting on a slower
+            # rank), and leaving for jax.distributed.shutdown before it
+            # returns abandons the peers inside the collective
+            timeout = 120.0 if self._dist_nprocs > 1 else 30.0
             if self._drain_requested:
                 left = self.drain_deadline - (time.time() - self._drain_t0)
-                timeout = max(5.0, min(30.0, left))
+                timeout = max(5.0, min(timeout, left))
             self._trainer_thread.join(timeout=timeout)
         if self._drain_requested:
             self._write_drain_checkpoint()
@@ -1280,6 +1491,10 @@ class Learner:
         it so the launcher knows a verified resume point is waiting."""
         self._install_signal_handlers()
         try:
+            if self._health is not None:
+                self._health.start()
+            if self._collective_watchdog is not None:
+                self._collective_watchdog.start()
             self._trainer_thread = threading.Thread(target=self.trainer.run, daemon=True)
             self._trainer_thread.start()
             self.worker.run()
@@ -1301,13 +1516,39 @@ class Learner:
                     timeout = max(5.0, min(120.0, left))
                 self._rollout_thread.join(timeout=timeout)
         finally:
+            if self._health is not None:
+                self._health.stop()
+            if self._collective_watchdog is not None:
+                self._collective_watchdog.stop()
             self._restore_signal_handlers()
         return EXIT_RESUMABLE if self._drain_requested else 0
+
+    @property
+    def shutdown_coherent(self) -> bool:
+        """True when every process reached (or will reach) the same run
+        end, so the synchronized ``jax.distributed.shutdown`` barrier is
+        safe to join: a clean finish or a cadence-AGREED drain.  False
+        after a follower-local drain (its SIGTERM never rode a broadcast)
+        — the peers are still running or leaving via ``_host_fault``'s
+        ``os._exit``, so they never join the barrier, and waiting in it
+        would end in the coordination service's SIGABRT instead of the
+        promised exit 75 (docs/fault_tolerance.md, one-rank SIGTERM row)."""
+        if self._dist_nprocs <= 1 or not self._drain_requested:
+            return True
+        return bool(getattr(self.trainer, "drain_agreed", False))
+
+
+def _finish_distributed(learner: "Learner") -> None:
+    from ..parallel.distributed import shutdown_distributed
+
+    if learner.shutdown_coherent:
+        shutdown_distributed()
 
 
 def train_main(args: Dict[str, Any]) -> None:
     learner = Learner(args)
     code = learner.run()
+    _finish_distributed(learner)
     if code:
         sys.exit(code)
 
@@ -1315,5 +1556,6 @@ def train_main(args: Dict[str, Any]) -> None:
 def train_server_main(args: Dict[str, Any]) -> None:
     learner = Learner(args, remote=True)
     code = learner.run()
+    _finish_distributed(learner)
     if code:
         sys.exit(code)

@@ -307,6 +307,24 @@ class Trainer:
         self.param_cache = None
         self.param_refresh = max(1, int(args.get("param_refresh_updates", 8)))
 
+        # -- multi-process epoch cadence (parallel/distributed.py) --------
+        # Set by the Learner when jax.process_count() > 1: every train
+        # step is then a cross-process collective, so epoch end / shutdown
+        # / drain are agreed through the coordinator's broadcasts instead
+        # of local flags — a local decision would wedge the other
+        # processes inside a collective forever.  The collective watchdog
+        # (parallel/health.py) bounds exactly that wedge when a peer dies.
+        self.cadence = None
+        self.collective_watchdog = None
+        self.on_agreed_finish = None  # learner disarms the health plane here
+        self.finished = False        # run() returned via an agreed stop
+        self.drain_agreed = False    # the epoch ended with the DRAIN bit
+        self._drain_flag = False     # coordinator: broadcast DRAIN next
+        self._fault_wedge_process = False  # freeze before the next collective
+        self._proceed_queue: queue.Queue = queue.Queue(maxsize=1)
+        self._awaiting_proceed = False
+        self._collective_dispatched = False  # arms the watchdog post-compile
+
         # -- divergence sentinel (docs/fault_tolerance.md) ----------------
         # The compiled step already SKIPPED any step with a nonfinite
         # loss/grad-norm/lr (parallel/train_step.py) — params can never be
@@ -412,7 +430,20 @@ class Trainer:
 
         Before the warmup threshold no training has happened — return
         immediately so the learner keeps serving (reference train.py:343-346).
+
+        On a multi-process FOLLOWER the boundary is not requested here at
+        all: the coordinator's broadcast ends the epoch on every process,
+        the snapshot lands in the queue, and the follower's learner calls
+        this only once it sees the queue populated — so the get below
+        never blocks on an epoch that was not already agreed.
         """
+        if self.cadence is not None and not self.cadence.is_coordinator:
+            while not self.stop_event.is_set():
+                try:
+                    return self.update_queue.get(timeout=1.0)
+                except queue.Empty:
+                    continue
+            return None, self.steps
         if not self._warmed_up():
             return None, self.steps
         self.update_flag = True
@@ -422,6 +453,108 @@ class Trainer:
             except queue.Empty:
                 continue
         return None, self.steps
+
+    def proceed(self, stop: bool) -> None:
+        """Multi-process coordinator only: the learner's continue/shutdown
+        decision for the epoch whose snapshot it just consumed.  run()
+        holds the next cadence collective until this arrives, then
+        broadcasts the decision so every trainer stops (or continues)
+        together.  A no-op unless run() is actually waiting — pre-warmup
+        boundaries deliver no snapshot and expect no proceed."""
+        if self.cadence is None or not self._awaiting_proceed:
+            return
+        self._proceed_queue.put(bool(stop))
+
+    def request_drain(self) -> None:
+        """Preemption drain entry point, cadence-aware.  Single-process:
+        stop the trainer mid-epoch (the historical behavior).  Multi-
+        process coordinator: set the DRAIN bit instead — the next cadence
+        broadcast ends the epoch on EVERY process coherently (a hard local
+        stop would leave the peers wedged in the next collective).  A
+        follower getting a local SIGTERM cannot drive the cadence; it
+        waits for the agreed drain or its drain deadline."""
+        if self.cadence is None:
+            self.stop()
+        elif self.cadence.is_coordinator:
+            self._drain_flag = True
+
+    def _await_proceed(self):
+        """Coordinator trainer, post-snapshot: block for the learner's
+        proceed decision (True = shutdown), or None when stop() forced the
+        thread down with no verdict ever delivered.  Bounded by stop_event
+        so a drain that bypasses the boundary cannot wedge the thread —
+        but a verdict that was ALREADY delivered must still be returned:
+        the learner's shutdown path is proceed(stop) immediately followed
+        by stop(), and if stop_event winning that race swallowed the
+        verdict, the final agree_stop broadcast would never be dispatched
+        and every follower would sit abandoned inside the collective until
+        the watchdog exits them 75 out of a CLEAN run."""
+        while not self.stop_event.is_set():
+            try:
+                return self._proceed_queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+        try:
+            return self._proceed_queue.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _agreed_finish(self) -> None:
+        """The stop/drain broadcast just returned on THIS rank — and, being
+        a collective, on every other rank within the same dispatch: the run
+        is coherently over everywhere.  Tell the learner so it disarms the
+        health plane NOW, not at run() teardown — teardown skews ranks by
+        arbitrary seconds (worker joins, final fetches), and an armed plane
+        would misread the first rank's silence as a lost host (pinned by
+        tests/test_health.py::test_disarm_silences_both_detectors)."""
+        if self.on_agreed_finish is not None:
+            self.on_agreed_finish()
+
+    # -- cadence / watchdog plumbing -----------------------------------------
+
+    def _wedge_forever(self) -> None:
+        """HANDYRL_FAULT_WEDGE_PROCESS landed on this rank: simulate a
+        frozen host — this thread never progresses and never exits."""
+        print(
+            "[fault] trainer wedged: no longer joining collectives "
+            "(HANDYRL_FAULT_WEDGE_PROCESS)",
+            file=sys.stderr,
+        )
+        while True:
+            time.sleep(60.0)
+
+    def _arm(self, tag: str) -> None:
+        wd = self.collective_watchdog
+        if wd is not None and self._collective_dispatched:
+            # first-ever dispatch pays jit compilation — the heartbeat
+            # plane covers pre-first-step peer deaths (compile-grace,
+            # same rationale as the plane watchdog's)
+            wd.arm(tag)
+
+    def _disarm(self) -> None:
+        wd = self.collective_watchdog
+        if wd is not None:
+            wd.disarm()
+
+    def _agree_step(self, stepped: bool) -> int:
+        """One cadence broadcast per loop iteration (multi-process only):
+        returns the agreed command.  The coordinator's epoch-end verdict
+        mirrors the single-process loop condition (update_flag armed and
+        at least one step taken); the DRAIN bit rides the same broadcast."""
+        if self._fault_wedge_process:
+            self._wedge_forever()
+        from ..parallel.distributed import CMD_DRAIN
+
+        self._arm("cadence agree_step")
+        try:
+            cmd = self.cadence.agree_step(
+                end=stepped and self.update_flag, drain=self._drain_flag
+            )
+        finally:
+            self._disarm()
+        if cmd & CMD_DRAIN:
+            self.drain_agreed = True
+        return cmd
 
     def _warmed_up(self) -> bool:
         """Epoch boundaries before the warmup threshold return immediately
@@ -521,26 +654,76 @@ class Trainer:
         self._sentinel_streak = 0
         self._loss_ema = None
         model_dir = self.args.get("model_dir", "models")
-        try:
-            epoch = ckpt.latest_verified_epoch(model_dir)
-        except ckpt.CheckpointError as exc:
-            print(
-                f"[sentinel] rollback wanted but the manifest is corrupt "
-                f"({exc}); keeping current params",
-                file=sys.stderr,
+        if self.cadence is not None:
+            # cross-process coherence: the streak that got us here is
+            # computed from the COLLECTIVE step metrics, so every rank is
+            # in this call together — but only the coordinator owns the
+            # checkpoint files.  Its manifest verdict AND the snapshot
+            # params themselves ride broadcasts, so all ranks roll back
+            # to the SAME manifest entry (or all keep params) and stay
+            # bit-identical; a follower scanning its own (possibly empty)
+            # model_dir would silently diverge.
+            from ..parallel.distributed import broadcast_params
+
+            local_epoch = 0
+            if self.cadence.is_coordinator:
+                try:
+                    local_epoch = ckpt.latest_verified_epoch(model_dir)
+                except ckpt.CheckpointError as exc:
+                    print(
+                        f"[sentinel] rollback wanted but the manifest is "
+                        f"corrupt ({exc}); keeping current params on every "
+                        "process",
+                        file=sys.stderr,
+                    )
+            self._arm("sentinel rollback agreement")
+            try:
+                epoch = self.cadence.agree_rollback_epoch(local_epoch)
+            finally:
+                self._disarm()
+            if epoch <= 0:
+                print(
+                    "[sentinel] divergence streak hit the rollback "
+                    "threshold but the coordinator has no verified "
+                    "snapshot; keeping current params (in-step skips "
+                    "already suppressed the bad updates)",
+                    file=sys.stderr,
+                )
+                return
+            if self.cadence.is_coordinator:
+                params = ckpt.load_verified_params(
+                    model_dir, epoch, self.state_host["params"],
+                    pre_verified=True,
+                )
+            else:
+                # like-shaped input; values replaced by the broadcast
+                params = self.state_host["params"]
+            self._arm("sentinel rollback params broadcast")
+            try:
+                params = broadcast_params(params, self.ctx.mesh)
+            finally:
+                self._disarm()
+        else:
+            try:
+                epoch = ckpt.latest_verified_epoch(model_dir)
+            except ckpt.CheckpointError as exc:
+                print(
+                    f"[sentinel] rollback wanted but the manifest is corrupt "
+                    f"({exc}); keeping current params",
+                    file=sys.stderr,
+                )
+                return
+            if epoch <= 0:
+                print(
+                    "[sentinel] divergence streak hit the rollback threshold "
+                    "but no verified snapshot exists yet; keeping current "
+                    "params (in-step skips already suppressed the bad updates)",
+                    file=sys.stderr,
+                )
+                return
+            params = ckpt.load_verified_params(
+                model_dir, epoch, self.state_host["params"], pre_verified=True
             )
-            return
-        if epoch <= 0:
-            print(
-                "[sentinel] divergence streak hit the rollback threshold "
-                "but no verified snapshot exists yet; keeping current "
-                "params (in-step skips already suppressed the bad updates)",
-                file=sys.stderr,
-            )
-            return
-        params = ckpt.load_verified_params(
-            model_dir, epoch, self.state_host["params"], pre_verified=True
-        )
         # init_state dispatches multi-device layout programs; mid-run the
         # rollout thread may be dispatching concurrently — init_state now
         # takes the learner mesh's locks per program itself (the locks are
@@ -606,8 +789,17 @@ class Trainer:
                     # on TPU dispatch is async and the gap never forms.
                     time.sleep(0.02)
         else:
+            from ..parallel.distributed import CMD_END
+
             last_batch = None
-            while data_cnt == 0 or not self.update_flag:
+            while True:
+                if self.cadence is not None:
+                    # coordinator-broadcast epoch end: every process runs
+                    # the SAME step count, or the next collective wedges
+                    if self._agree_step(data_cnt > 0) & CMD_END:
+                        break
+                elif data_cnt > 0 and self.update_flag:
+                    break
                 t0 = time.perf_counter()
                 batch = self.batcher.batch()
                 batch_wait = time.perf_counter() - t0
@@ -623,13 +815,37 @@ class Trainer:
                 else:
                     wait_s += batch_wait  # input starvation (north-star)
                 if batch is None:  # shutting down
+                    if (
+                        self.cadence is not None
+                        and self.cadence.is_coordinator
+                    ):
+                        # the stop landed while this rank was starved in
+                        # batch() (forced drain-deadline shutdown): end
+                        # the epoch THROUGH the cadence — a bare break
+                        # would abandon the broadcast the followers are
+                        # (or will be) blocked in, stranding them on the
+                        # collective watchdog's full timeout.  This holds
+                        # even when _drain_flag is ALREADY set: reaching
+                        # here proves the bit never rode a broadcast (a
+                        # broadcast DRAIN breaks the loop at agree_step,
+                        # before batch() runs again), so the next loop-top
+                        # iteration is the one that finally sends END|DRAIN.
+                        # The watchdog armed around that broadcast still
+                        # bounds this rank if the peers are already gone.
+                        self._drain_flag = True
+                        continue
                     break
                 last_batch = batch  # batches aren't donated; safe to re-lower
                 step_lr = self._step_lr(lr, fused)
-                if fused > 1:  # k updates per device call, metrics pre-summed
-                    self.state, metrics = self.ctx.train_steps(self.state, batch, step_lr)
-                else:
-                    self.state, metrics = self.ctx.train_step(self.state, batch, step_lr)
+                self._arm("train_step @ step %d" % self.steps)
+                try:
+                    if fused > 1:  # k updates per device call, metrics pre-summed
+                        self.state, metrics = self.ctx.train_steps(self.state, batch, step_lr)
+                    else:
+                        self.state, metrics = self.ctx.train_step(self.state, batch, step_lr)
+                finally:
+                    self._disarm()
+                self._collective_dispatched = True
                 metric_accum.append(metrics)
                 batch_cnt += fused
                 self.steps += fused
@@ -639,8 +855,12 @@ class Trainer:
         if not metric_accum:
             return self.state_host["params"]
 
-        # graftlint: allow[HS001] reason=epoch-end fetch of the whole epoch's metrics in one device_get — once per epoch, not per dispatch
-        fetched = jax.device_get(metric_accum)
+        self._arm("epoch-end metrics fetch")
+        try:
+            # graftlint: allow[HS001] reason=epoch-end fetch of the whole epoch's metrics in one device_get — once per epoch, not per dispatch
+            fetched = jax.device_get(metric_accum)
+        finally:
+            self._disarm()
         skipped_steps = 0
         if self.sentinel:
             # skip flags + spike detection + (possibly) rollback — all on
@@ -793,7 +1013,56 @@ class Trainer:
                     print(f"wrote profiler trace to {profile_dir}")
                     tracing = False
                 self.update_flag = False
+                if self.cadence is not None:
+                    self._awaiting_proceed = True
                 self.update_queue.put((params, self.steps))
+                if self.cadence is not None:
+                    if self.drain_agreed:
+                        # agreed preemption drain: no further collectives;
+                        # every process leaves the loop at this boundary
+                        self.finished = True
+                        self._agreed_finish()
+                        return
+                    # the coordinator waits for its learner's shutdown
+                    # decision, then broadcasts it; followers join the
+                    # broadcast directly — all trainers stop (or start the
+                    # next epoch) together.  The coordinator skips the
+                    # broadcast ONLY when no verdict was ever delivered
+                    # (forced stop mid-drain): a delivered verdict is
+                    # always broadcast even if stop() already landed,
+                    # because the followers are (or will be) blocked in
+                    # this collective waiting for it.
+                    if self.cadence.is_coordinator:
+                        stop_local = self._await_proceed()
+                        self._awaiting_proceed = False
+                        if stop_local is None:
+                            return
+                        # only the coordinator arms the boundary stop: a
+                        # follower reaches this collective right after its
+                        # queue put, but the coordinator joins only after
+                        # its learner's boundary work (eval feed, verified
+                        # checkpoint write, snapshot GC) — at production
+                        # sizes that legitimately exceeds the collective
+                        # bound, and an armed follower would exit 75 out
+                        # of a healthy fleet.  A coordinator that dies in
+                        # that window is the heartbeat plane's catch.
+                        self._arm("cadence agree_stop")
+                    else:
+                        stop_local = False
+                        self._awaiting_proceed = False
+                        if self.stop_event.is_set():
+                            # follower forced down locally (drain deadline
+                            # past): it cannot drive the cadence; peers
+                            # escape through the collective watchdog
+                            return
+                    try:
+                        stop = self.cadence.agree_stop(stop_local)
+                    finally:
+                        self._disarm()
+                    if stop:
+                        self.finished = True
+                        self._agreed_finish()
+                        return
         finally:
             if tracing:  # interrupted mid-first-epoch: still flush the trace
                 jax.profiler.stop_trace()

@@ -51,6 +51,14 @@ METRIC_KEYS = frozenset({
     "league_population", "league_pool", "league_matches", "league_forfeits",
     "league_payoff_coverage", "league_candidate_wp", "league_elo_spread",
     "league_promotions",
+    # multi-process learner plane (parallel/distributed.py + health.py):
+    # dist_processes is the run's process count; the rest are cumulative
+    # cross-host health events — heartbeat misses observed, collective-
+    # timeout watchdog aborts, and peer/coordinator-loss drains.  Written
+    # by the coordinator's per-epoch record and, on a host fault, by the
+    # final pre-exit drain record (runtime/learner.py)
+    "dist_processes", "dist_heartbeat_misses", "dist_collective_timeouts",
+    "dist_peer_loss_drains",
 })
 # key families written from the *_KEYS tuples (trainer/learner) and the
 # per-epoch plane-health diffs; one prefix registers the family

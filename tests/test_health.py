@@ -1,0 +1,322 @@
+"""Cross-host health plane units (parallel/health.py) — socket-free where
+possible, one localhost round-trip where the wire itself is the claim.
+
+These are the fast half of the multihost suite: the monitor/watchdog
+decision logic runs against an injected clock (no sleeps, no jax, no
+subprocesses), so the host-loss detection bounds asserted by the slow
+e2es in tests/test_multihost.py are pinned cheaply on every leg.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from handyrl_tpu.parallel.health import (
+    CollectiveWatchdog,
+    HostHealthPlane,
+    resolve_health_port,
+)
+from handyrl_tpu.runtime import faults
+
+pytestmark = pytest.mark.multihost
+
+
+def _plane(on_fault, clock, interval=1.0, timeout=5.0, rank=0, nprocs=3):
+    return HostHealthPlane(
+        {
+            "coordinator_address": "127.0.0.1:6000",
+            "heartbeat_interval": interval,
+            "heartbeat_timeout": timeout,
+        },
+        rank,
+        nprocs,
+        on_fault,
+        clock=clock,
+    )
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_health_port_defaults_to_coordinator_port_plus_one():
+    assert resolve_health_port({"coordinator_address": "10.0.0.1:1234"}) == 1235
+    assert resolve_health_port(
+        {"coordinator_address": "10.0.0.1:1234", "health_port": 7777}
+    ) == 7777
+
+
+def test_peer_silence_counts_misses_then_declares_loss():
+    clock = _Clock()
+    events = []
+    plane = _plane(lambda r, k: events.append((r, k)), clock)
+    plane._started_at = clock()
+    # both peers beat once at t=100
+    plane.last_seen[1] = clock()
+    plane.last_seen[2] = clock()
+    assert plane.check_peers() is None
+    # rank 2 keeps beating, rank 1 goes silent
+    clock.t += 2.0
+    plane.last_seen[2] = clock()
+    assert plane.check_peers() is None  # 2s silence: a miss, not a loss
+    assert plane.events["heartbeat_misses"] >= 1
+    misses_at_2s = plane.events["heartbeat_misses"]
+    clock.t += 1.0
+    plane.last_seen[2] = clock()
+    plane.check_peers()
+    clock.t += 2.5  # rank 1 now 5.5s silent > timeout 5.0
+    plane.last_seen[2] = clock()
+    assert plane.check_peers() == 1
+    assert plane.events["peer_losses"] == 1
+    assert 1 in plane.lost
+    # one miss per silent interval, not per monitor tick
+    assert plane.events["heartbeat_misses"] >= misses_at_2s
+    # the healthy peer is never declared lost on later ticks
+    clock.t += 0.1
+    plane.last_seen[2] = clock()
+    assert plane.check_peers() is None
+
+
+def test_peer_that_never_joined_is_lost_after_grace():
+    clock = _Clock()
+    plane = _plane(lambda r, k: None, clock, nprocs=2)
+    plane._started_at = clock()
+    assert plane.check_peers() is None  # inside the join grace
+    clock.t += 5.5
+    assert plane.check_peers() == 1  # died between jax init and plane start
+    assert plane.events["peer_losses"] == 1
+
+
+def test_fault_callback_fires_at_most_once():
+    calls = []
+    plane = _plane(lambda r, k: calls.append(k), _Clock())
+    plane._fault("a", "peer_loss")
+    plane._fault("b", "peer_loss")
+    plane._fault("c", "coordinator_loss")
+    assert calls == ["peer_loss"]
+
+
+def test_disarm_silences_both_detectors():
+    """After the cadence's agreed stop/drain boundary the trainer disarms
+    the plane: teardown is NOT lockstep (worker joins, final fetches skew
+    the ranks by seconds), so post-run peer silence must never be declared
+    a host fault — an armed plane here os._exit(75)s out of a CLEAN run
+    (the first rank to stop answering looks exactly like a lost host)."""
+    clock = _Clock()
+    calls = []
+    plane = _plane(lambda r, k: calls.append(k), clock)
+    plane._started_at = clock()
+    plane.last_seen[1] = clock()
+    plane.last_seen[2] = clock()
+    plane.disarm()
+    clock.t += 100.0  # both peers silent far past heartbeat_timeout
+    rank = plane.check_peers()
+    if rank is not None:  # monitor tick still books the silence...
+        plane._fault("peer 1 silent", "peer_loss")
+    plane._fault("coordinator silent", "coordinator_loss")
+    assert calls == []  # ...but no loss can be declared
+
+
+def test_collective_watchdog_fires_only_past_timeout_and_once():
+    clock = _Clock()
+    fired = []
+    wd = CollectiveWatchdog(10.0, fired.append, clock=clock)
+    assert not wd.check()  # never armed
+    wd.arm("train_step @ step 7")
+    clock.t += 9.0
+    assert not wd.check()
+    clock.t += 2.0  # 11s armed > 10s timeout
+    assert wd.check()
+    assert len(fired) == 1 and "train_step @ step 7" in fired[0]
+    assert "collective_timeout" in fired[0]
+    clock.t += 100.0
+    assert wd.check()  # latched, but no second callback
+    assert len(fired) == 1
+    assert wd.fired
+
+
+def test_collective_watchdog_disarm_prevents_firing():
+    clock = _Clock()
+    fired = []
+    wd = CollectiveWatchdog(10.0, fired.append, clock=clock)
+    wd.arm("x")
+    clock.t += 9.9
+    wd.disarm()
+    clock.t += 100.0
+    assert not wd.check()
+    assert fired == []
+    # zero timeout disables entirely
+    wd0 = CollectiveWatchdog(0.0, fired.append, clock=clock)
+    wd0.arm("y")
+    clock.t += 1e6
+    assert not wd0.check()
+    assert fired == []
+
+
+def test_heartbeat_roundtrip_and_loss_echo_over_localhost():
+    """One real TCP round-trip: a heartbeat lands in last_seen and the ack
+    echoes the coordinator's lost set — the transport under the e2es."""
+    import json
+
+    plane = _plane(lambda r, k: None, time.monotonic, interval=0.2, timeout=2.0)
+    plane._port = 0  # pick an ephemeral port below
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.bind(("127.0.0.1", 0))
+    server.listen(1)
+    plane._server = server
+    plane.lost.add(2)  # pre-lost peer must be echoed to survivors
+
+    def serve_one():
+        conn, _ = server.accept()
+        conn.settimeout(5.0)
+        plane._serve_peer(conn)
+
+    t = threading.Thread(target=serve_one, daemon=True)
+    t.start()
+    port = server.getsockname()[1]
+    with socket.create_connection(("127.0.0.1", port), timeout=5.0) as c:
+        c.sendall(json.dumps({"rank": 1, "seq": 1}).encode() + b"\n")
+        ack = json.loads(c.makefile().readline())
+    assert ack["ok"] == 1
+    assert ack["lost"] == [2]
+    assert 1 in plane.last_seen
+    plane.stop()
+    server.close()
+
+
+def test_wedge_stops_heartbeats_without_teardown():
+    plane = _plane(lambda r, k: None, _Clock(), rank=1)
+    assert plane._beat.is_set()
+    plane.stop_heartbeats()
+    assert not plane._beat.is_set()
+    assert not plane._stop.is_set()  # the plane itself is still up
+
+
+def test_wedged_coordinator_stops_acking():
+    """HANDYRL_FAULT_WEDGE_PROCESS on rank 0 must make the follower-side
+    detector reachable: the coordinator's REAL server half (_serve_peer)
+    stops acking once wedged, so followers see their beats unanswered and
+    declare coordinator_loss within the bound."""
+    import json
+
+    plane = _plane(lambda r, k: None, time.monotonic, interval=0.2, timeout=2.0)
+    a, b = socket.socketpair()
+    t = threading.Thread(target=plane._serve_peer, args=(b,), daemon=True)
+    t.start()
+    try:
+        a.settimeout(2.0)
+        a.sendall(json.dumps({"rank": 1, "seq": 1}).encode() + b"\n")
+        assert b"\n" in a.recv(4096)  # healthy: beat is acked
+        plane.stop_heartbeats()       # wedge lands on the coordinator
+        a.sendall(json.dumps({"rank": 1, "seq": 2}).encode() + b"\n")
+        a.settimeout(0.5)
+        with pytest.raises(socket.timeout):
+            a.recv(4096)              # wedged: beat received, never acked
+    finally:
+        plane._stop.set()
+        a.close()
+
+
+# -- host-loss fault injection parsing (runtime/faults.py) --------------------
+
+
+def test_kill_and_wedge_fault_parsing(monkeypatch):
+    monkeypatch.delenv("HANDYRL_FAULT_KILL_PROCESS_AT_EPOCH", raising=False)
+    monkeypatch.delenv("HANDYRL_FAULT_WEDGE_PROCESS", raising=False)
+    assert faults.kill_process_at_epoch() is None
+    assert faults.wedge_process_at_epoch() is None
+    monkeypatch.setenv("HANDYRL_FAULT_KILL_PROCESS_AT_EPOCH", "2:1")
+    assert faults.kill_process_at_epoch() == (2, 1)
+    monkeypatch.setenv("HANDYRL_FAULT_KILL_PROCESS_AT_EPOCH", "3")
+    assert faults.kill_process_at_epoch() == (3, 0)  # bare epoch = rank 0
+    monkeypatch.setenv("HANDYRL_FAULT_WEDGE_PROCESS", "4:2")
+    assert faults.wedge_process_at_epoch() == (4, 2)
+
+
+@pytest.mark.parametrize("raw", ["", ":", "x", "1:x", "1:2:3", "1.5"])
+def test_malformed_host_fault_is_loud(monkeypatch, raw):
+    if raw == "":
+        monkeypatch.setenv("HANDYRL_FAULT_KILL_PROCESS_AT_EPOCH", raw)
+        assert faults.kill_process_at_epoch() is None  # unset/blank = off
+        return
+    monkeypatch.setenv("HANDYRL_FAULT_KILL_PROCESS_AT_EPOCH", raw)
+    with pytest.raises(ValueError):
+        faults.kill_process_at_epoch()
+
+
+# -- config validation for the new distributed.* knobs ------------------------
+
+
+def _cfg(dist):
+    from handyrl_tpu.config import normalize_args
+
+    return normalize_args(
+        {"env_args": {"env": "TicTacToe"}, "train_args": {"distributed": dist}}
+    )
+
+
+def test_distributed_knob_validation():
+    ok = _cfg({"heartbeat_interval": 1.0, "heartbeat_timeout": 5.0})
+    assert ok["train_args"]["distributed"]["initialization_timeout"] == 300.0
+    with pytest.raises(ValueError, match="initialization_timeout"):
+        _cfg({"initialization_timeout": 0})
+    with pytest.raises(ValueError, match="heartbeat_timeout"):
+        _cfg({"heartbeat_timeout": -1})
+    with pytest.raises(ValueError, match="2x"):
+        _cfg({"heartbeat_interval": 5.0, "heartbeat_timeout": 6.0})
+    with pytest.raises(ValueError, match="collective_timeout"):
+        _cfg({"collective_timeout": -1})
+    with pytest.raises(ValueError, match="health_port"):
+        _cfg({"health_port": 99999})
+    with pytest.raises(ValueError, match="num_processes"):
+        _cfg({"num_processes": 0})
+    # a port-less address must fail as a named knob error at config time,
+    # not as a bare int() traceback inside the init pre-flight or
+    # resolve_health_port
+    with pytest.raises(ValueError, match="coordinator_address"):
+        _cfg({"coordinator_address": "10.0.0.1"})
+    with pytest.raises(ValueError, match="coordinator_address"):
+        _cfg({"coordinator_address": "10.0.0.1:notaport"})
+    assert _cfg({"coordinator_address": "10.0.0.1:1234"})
+    # coordinator port 65535 is valid, but the DERIVED health port
+    # (coordinator port + 1) is not — demand an explicit health_port
+    with pytest.raises(ValueError, match="health_port"):
+        _cfg({"coordinator_address": "10.0.0.1:65535"})
+    with pytest.raises(ValueError, match="health_port"):
+        _cfg({"coordinator_address": "10.0.0.1:065535"})  # numeric, not string, compare
+    assert _cfg({"coordinator_address": "10.0.0.1:65535", "health_port": 7777})
+    # a disabled plane (heartbeat_interval 0) never derives the port
+    assert _cfg({"coordinator_address": "10.0.0.1:65535", "heartbeat_interval": 0})
+
+
+def test_multiprocess_rejects_per_process_local_planes():
+    from handyrl_tpu.config import normalize_args
+
+    dist = {"num_processes": 2, "coordinator_address": "127.0.0.1:6000"}
+    with pytest.raises(ValueError, match="device_replay"):
+        normalize_args(
+            {"env_args": {"env": "TicTacToe"},
+             "train_args": {"distributed": dict(dist),
+                            "device_rollout_games": 8, "device_replay": True}}
+        )
+    with pytest.raises(ValueError, match="plane: split"):
+        normalize_args(
+            {"env_args": {"env": "TicTacToe"},
+             "train_args": {"distributed": dict(dist),
+                            "device_rollout_games": 8, "plane": "split"}}
+        )
+    # num_processes alone may be a fleet template: without a
+    # coordinator_address the plane never activates (init_distributed
+    # returns 0), so the same knobs must VALIDATE
+    ok = normalize_args(
+        {"env_args": {"env": "TicTacToe"},
+         "train_args": {"distributed": {"num_processes": 2},
+                        "device_rollout_games": 8, "plane": "split"}}
+    )
+    assert ok["train_args"]["plane"] == "split"

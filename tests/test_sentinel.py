@@ -265,6 +265,7 @@ def _bare_trainer(rollback_after=3, spike_factor=10.0, fused=1):
     t._sentinel_streak = 0
     t.sentinel_events = {k: 0 for k in SENTINEL_EVENT_KEYS}
     t.fused = fused
+    t.cadence = None  # single-process: no multi-host rollback broadcasts
     t.rolled = 0
     t._sentinel_rollback = lambda: setattr(t, "rolled", t.rolled + 1) or _reset(t)
     return t

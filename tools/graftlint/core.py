@@ -220,6 +220,11 @@ class LintConfig:
         # the league plane sits inside the learner's epoch/feed loops and
         # the actors' match loop: a host sync here stalls generation
         "handyrl_tpu/league/*.py",
+        # the multi-process cadence runs once per SGD step on the trainer
+        # thread and the health plane's threads run beside every dispatch:
+        # a stray sync here is a per-step cross-host stall
+        "handyrl_tpu/parallel/distributed.py",
+        "handyrl_tpu/parallel/health.py",
     )
     # functions (bare names) that are drain/teardown/construction paths —
     # host syncs there are the POINT, not a leak
@@ -249,6 +254,10 @@ class LintConfig:
         # league opponent engines co-reside with the training plane (and
         # each other) on the same chips — same invariant as serving
         "handyrl_tpu/league/*.py",
+        # the cadence broadcasts are device programs sharing the learner
+        # mesh with the train step: same lock discipline as every dispatch
+        "handyrl_tpu/parallel/distributed.py",
+        "handyrl_tpu/parallel/health.py",
     )
     dispatch_wrapper: str = "dispatch_serialized"
 
