@@ -252,6 +252,36 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
         # books persist).  The anchor always stays active
         "max_population": 16,
     },
+    # --- observability plane (docs/observability.md) --------------------
+    # structured span tracing (utils/trace.py): ring-buffered in-process
+    # spans over the hot-path seams (dispatch, batch waits, cadence
+    # broadcasts, heartbeats, serving lifecycle, epoch-boundary work),
+    # flushed to trace.jsonl with the metrics.jsonl tail discipline and
+    # exportable to chrome://tracing via scripts/trace_export.py.  OFF by
+    # default and provably free: with enabled: false the hot path is
+    # bit-identical (one attribute check per seam) — pinned by the obs
+    # sanitizer suite
+    "trace": {
+        "enabled": False,
+        # sink path; multi-process ranks N > 0 derive path.rankN.jsonl
+        "path": "trace.jsonl",
+        # bounded in-process span ring: a full ring DROPS (counted in the
+        # trace_dropped metric), never blocks a dispatch
+        "ring_size": 4096,
+        # background flusher cadence, seconds
+        "flush_interval": 0.5,
+        # also enter a jax.profiler.TraceAnnotation per span so host spans
+        # land inside XLA device profiles (profile_dir captures)
+        "annotate_device": True,
+    },
+    "observability": {
+        # multi-process runs: followers piggyback per-epoch metric
+        # snapshots on health-plane heartbeats so the coordinator's
+        # metrics.jsonl carries rank_* aggregates for EVERY rank (a
+        # wedged-but-heartbeating follower is visible as a stale rank
+        # report before the collective watchdog's bound)
+        "rank_metrics": True,
+    },
     # N > 0: when an env's vector twin is autovec-lifted (envs/autovec.py
     # __autovec__), play N random step-parity games between the numpy
     # rules and the lifted device env at Learner startup and refuse to
@@ -639,6 +669,31 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         )
     if int(train["autovec_verify_games"]) < 0:
         raise ValueError("train_args.autovec_verify_games must be >= 0 (0 = off)")
+    tr = train["trace"]
+    if not isinstance(tr["enabled"], bool):
+        raise ValueError(
+            f"train_args.trace.enabled={tr['enabled']!r} must be a bool"
+        )
+    if tr["enabled"] and not str(tr["path"] or "").strip():
+        raise ValueError(
+            "train_args.trace.path must name a file when trace.enabled is "
+            "true (writability is probed at startup by trace.configure)"
+        )
+    if int(tr["ring_size"]) < 1:
+        raise ValueError("train_args.trace.ring_size must be >= 1")
+    if float(tr["flush_interval"]) <= 0:
+        raise ValueError("train_args.trace.flush_interval must be > 0")
+    if not isinstance(tr["annotate_device"], bool):
+        raise ValueError(
+            f"train_args.trace.annotate_device={tr['annotate_device']!r} "
+            "must be a bool"
+        )
+    obs = train["observability"]
+    if not isinstance(obs["rank_metrics"], bool):
+        raise ValueError(
+            f"train_args.observability.rank_metrics="
+            f"{obs['rank_metrics']!r} must be a bool"
+        )
     if train["seq_attention"] not in ("auto", "flash", "einsum", "ring"):
         raise ValueError(
             f"train_args.seq_attention={train['seq_attention']!r} "

@@ -315,16 +315,20 @@ class DistributedCadence:
         self.is_coordinator = is_coordinator()
         self.num_processes = process_count()
 
-    def _agree(self, value: int) -> int:
+    def _agree(self, value: int, tag: str = "agree") -> int:
+        from ..utils.trace import trace_span
         from .mesh import dispatch_serialized
 
         # broadcast_one_to_all returns a host value: the device_get is the
         # point of the call (the cadence decision must reach the host), so
         # it lives inside the dispatch scope like the CPU backend's other
-        # blocking dispatches
-        return dispatch_serialized(
-            lambda: broadcast_from_coordinator(value), self.mesh
-        )
+        # blocking dispatches.  The span times the whole rendezvous: under
+        # rank skew it IS the wait for the slowest process, which is the
+        # cross-host stall the observability plane exists to attribute
+        with trace_span("cadence." + tag, plane="cadence"):
+            return dispatch_serialized(
+                lambda: broadcast_from_coordinator(value), self.mesh
+            )
 
     def agree_step(self, end: bool, drain: bool) -> int:
         """One per trainer-loop iteration: the coordinator passes its local
@@ -332,12 +336,14 @@ class DistributedCadence:
         cmd = CMD_CONTINUE
         if self.is_coordinator and (end or drain):
             cmd = CMD_END | (CMD_DRAIN if drain else 0)
-        return self._agree(cmd)
+        return self._agree(cmd, "agree_step")
 
     def agree_stop(self, stop: bool) -> bool:
         """One per epoch boundary (unless the epoch drained): the
         coordinator passes its learner's continue/shutdown decision."""
-        return bool(self._agree(1 if (self.is_coordinator and stop) else 0))
+        return bool(
+            self._agree(1 if (self.is_coordinator and stop) else 0, "agree_stop")
+        )
 
     def agree_rollback_epoch(self, epoch: int) -> int:
         """Sentinel-rollback agreement: the coordinator passes its
@@ -346,4 +352,6 @@ class DistributedCadence:
         reaches this call together because the streak that triggers it is
         computed from the COLLECTIVE step metrics (identical on all
         ranks)."""
-        return self._agree(int(epoch) if self.is_coordinator else 0)
+        return self._agree(
+            int(epoch) if self.is_coordinator else 0, "agree_rollback"
+        )

@@ -175,6 +175,24 @@ class HostHealthPlane:
             "peer_losses": 0,
             "coordinator_losses": 0,
         }
+        # -- cross-host metric relay (observability.rank_metrics) ---------
+        # follower side: the next heartbeat carries this snapshot once;
+        # coordinator side: rank -> (snapshot, arrival monotonic).  PR 12
+        # made metrics.jsonl coordinator-only — this is how follower ranks
+        # get back INTO it, as rank_* aggregates, without a second
+        # transport (the beats are already flowing)
+        self._pending_metrics: Optional[Dict[str, Any]] = None
+        self._metrics_lock = threading.Lock()
+        # rank -> (snapshot, arrival) — written by per-connection serve
+        # threads, read at epoch boundaries: every access holds
+        # _metrics_lock (a first-beat insert racing the learner's fold
+        # would otherwise die on dict-changed-size)
+        self.peer_metrics: Dict[int, tuple] = {}
+        # report-cadence EMA for the staleness verdict: snapshots arrive
+        # once per EPOCH, not per beat, so "stale" must key off the
+        # observed aggregation period (the beat interval only floors it)
+        self._agg_period: Optional[float] = None
+        self._last_agg_at: Optional[float] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -225,6 +243,104 @@ class HostHealthPlane:
         with self._fault_lock:
             self._faulted = True
 
+    # -- cross-host metric relay ---------------------------------------------
+
+    def offer_metrics(self, snapshot: Dict[str, Any]) -> None:
+        """Follower side: queue one per-epoch metric snapshot to ride the
+        next heartbeat (newest wins — the relay is a health signal, not a
+        lossless stream).  A no-op on a disabled plane."""
+        with self._metrics_lock:
+            self._pending_metrics = dict(snapshot)
+
+    def _take_pending_metrics(self) -> Optional[Dict[str, Any]]:
+        with self._metrics_lock:
+            snap, self._pending_metrics = self._pending_metrics, None
+            return snap
+
+    def _restore_pending_metrics(self, snap: Optional[Dict[str, Any]]) -> None:
+        """A failed send must not lose the epoch's snapshot — restore it
+        unless a newer one was offered meanwhile."""
+        if snap is None:
+            return
+        with self._metrics_lock:
+            if self._pending_metrics is None:
+                self._pending_metrics = snap
+
+    def note_peer_metrics(self, rank: int, snapshot: Dict[str, Any],
+                          now: Optional[float] = None) -> None:
+        """Coordinator side: file a follower's metric snapshot (public for
+        socket-free unit tests; ``_serve_peer`` is the wire caller)."""
+        at = self._clock() if now is None else now
+        with self._metrics_lock:
+            self.peer_metrics[int(rank)] = (dict(snapshot), at)
+
+    def rank_aggregates(self, own: Dict[str, Any],
+                        now: Optional[float] = None) -> Dict[str, Any]:
+        """Coordinator side: fold the per-rank snapshots (self = rank 0,
+        fresh; followers = last relayed) into the ``rank_*`` metrics keys.
+
+        The staleness fields are the point: a WEDGED-but-heartbeating
+        follower keeps acking but its trainer stops, so its relayed epoch/
+        steps freeze and ``rank_report_age_s_max`` grows past the epoch
+        cadence — visible in metrics.jsonl long before the collective
+        watchdog's bound fires (docs/observability.md §Rank aggregates).
+
+        Snapshots arrive once per EPOCH (a follower one boundary behind is
+        the healthy steady state), so the stale verdict keys off the
+        OBSERVED aggregation cadence: a report older than 2.5x the period
+        EMA — floored at 3 heartbeat intervals for second-scale epochs —
+        is stale.  The bound uses the EMA from BEFORE this call's gap, so
+        a host-fault fold minutes after the last boundary judges against
+        the healthy cadence, not the wedge-stretched gap.
+        """
+        now = self._clock() if now is None else now
+        reports = [(0, dict(own), now)]
+        with self._metrics_lock:
+            peers = sorted(self.peer_metrics.items())
+        for rank, (snap, at) in peers:
+            reports.append((rank, snap, at))
+        # pre-update EMA -> stale bound; then fold this call's gap in
+        stale_bound = (
+            max(3.0 * max(self.interval, 1e-6), 2.5 * self._agg_period)
+            if self._agg_period is not None
+            else None  # first fold: no cadence observed, no stale verdict
+        )
+        if self._last_agg_at is not None and now > self._last_agg_at:
+            gap = now - self._last_agg_at
+            self._agg_period = (
+                gap if self._agg_period is None
+                else 0.5 * self._agg_period + 0.5 * gap
+            )
+        self._last_agg_at = now
+        out: Dict[str, Any] = {"rank_reports": len(reports)}
+
+        def fold(key: str, values, digits: int = 4) -> None:
+            vals = [float(v) for v in values if v is not None]
+            if not vals:
+                return
+            out[f"rank_{key}_min"] = round(min(vals), digits)
+            out[f"rank_{key}_max"] = round(max(vals), digits)
+            out[f"rank_{key}_mean"] = round(sum(vals) / len(vals), digits)
+
+        fold("epoch", [s.get("epoch") for _, s, _ in reports], 0)
+        fold("steps", [s.get("steps") for _, s, _ in reports], 0)
+        fold("train_steps_per_sec",
+             [s.get("train_steps_per_sec") for _, s, _ in reports])
+        fold("input_wait_frac",
+             [s.get("input_wait_frac") for _, s, _ in reports])
+        ages = [max(0.0, now - at) for _, _, at in reports]
+        out["rank_report_age_s_max"] = round(max(ages), 2)
+        # ranks (self included via its 0 age) whose report outlived the
+        # cadence-derived bound: the wedged-follower flag.  The raw max
+        # age above is always reported, so operators can judge even on
+        # the first fold (where no bound exists yet)
+        out["rank_stale_reports"] = (
+            sum(1 for a in ages if a > stale_bound)
+            if stale_bound is not None else 0
+        )
+        out["rank_missing_reports"] = self.num_processes - len(reports)
+        return out
+
     def _spawn(self, target, name: str) -> None:
         t = threading.Thread(target=target, daemon=True, name=name)
         # per-connection _serve_peer threads arrive once per follower
@@ -272,6 +388,11 @@ class HostHealthPlane:
                         continue
                     self._conn_by_rank[rank] = conn
                     self.last_seen[rank] = self._clock()
+                    snap = msg.get("metrics")
+                    if isinstance(snap, dict):
+                        # per-epoch metric snapshot riding the beat: file
+                        # it for the learner's rank_* aggregates
+                        self.note_peer_metrics(rank, snap)
                     ack = json.dumps({"ok": 1, "lost": sorted(self.lost)})
                     conn.sendall(ack.encode() + b"\n")
         except OSError:
@@ -358,6 +479,10 @@ class HostHealthPlane:
     # -- follower half -------------------------------------------------------
 
     def _client_loop(self) -> None:
+        # lazy: trace is stdlib-only, but the utils package init pulls jax
+        # — keep health.py's module import jax-free for socket-free units
+        from ..utils.trace import trace_span
+
         last_ok = self._clock()
         conn: Optional[socket.socket] = None
         buf = b""
@@ -371,6 +496,7 @@ class HostHealthPlane:
             if not self._beat.is_set():   # wedged: go silent, stay up
                 time.sleep(self.interval)
                 continue
+            pending = None
             try:
                 if conn is None:
                     conn = socket.create_connection(
@@ -380,17 +506,22 @@ class HostHealthPlane:
                     buf = b""
                 seq += 1
                 attempts_since_ok += 1
-                conn.sendall(
-                    json.dumps({"rank": self.process_id, "seq": seq}).encode()
-                    + b"\n"
-                )
-                while b"\n" not in buf:
-                    chunk = conn.recv(4096)
-                    if not chunk:
-                        raise OSError("health connection closed")
-                    buf += chunk
+                msg: Dict[str, Any] = {"rank": self.process_id, "seq": seq}
+                pending = self._take_pending_metrics()
+                if pending is not None:
+                    # the per-epoch metric snapshot piggybacks on the beat
+                    # (one send covers liveness AND observability)
+                    msg["metrics"] = pending
+                with trace_span("health.heartbeat", plane="health", seq=seq):
+                    conn.sendall(json.dumps(msg).encode() + b"\n")
+                    while b"\n" not in buf:
+                        chunk = conn.recv(4096)
+                        if not chunk:
+                            raise OSError("health connection closed")
+                        buf += chunk
                 line, buf = buf.split(b"\n", 1)
                 ack = json.loads(line)
+                pending = None  # acked: the snapshot reached the books
                 last_ok = self._clock()
                 attempts_since_ok = 0
                 lost = [r for r in ack.get("lost", []) if r != self.process_id]
@@ -403,6 +534,7 @@ class HostHealthPlane:
                     return
             except (OSError, ValueError, socket.timeout):
                 self.events["heartbeat_misses"] += 1
+                self._restore_pending_metrics(pending)
                 if conn is not None:
                     try:
                         conn.close()

@@ -24,6 +24,8 @@ from typing import Dict, Optional, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..utils.trace import trace_span
+
 # Serializes the DISPATCH of multi-device (collective-bearing) programs
 # PER DEVICE.  Two SPMD programs enqueued concurrently from different host
 # threads — e.g. the sharded train step and the sharded device rollout —
@@ -84,13 +86,19 @@ def dispatch_serialized(call, devices=None):
     try:
         # acquisition inside the try: an async exception (Ctrl-C) landing
         # mid-loop must release the locks already held, or every later
-        # dispatch touching those devices deadlocks
-        for lock in locks:
-            lock.acquire()
-            held.append(lock)
-        out = call()
-        if jax.default_backend() == "cpu":
-            jax.block_until_ready(out)
+        # dispatch touching those devices deadlocks.  The spans (trace:
+        # enabled only — disabled is one attribute check and a shared
+        # no-op context) split lock contention from program time: on CPU
+        # "dispatch.run" includes execution (the lock covers readiness),
+        # on TPU it is enqueue time only
+        with trace_span("dispatch.wait", devices=len(locks)):
+            for lock in locks:
+                lock.acquire()
+                held.append(lock)
+        with trace_span("dispatch.run", devices=len(locks)):
+            out = call()
+            if jax.default_backend() == "cpu":
+                jax.block_until_ready(out)
         return out
     finally:
         for lock in reversed(held):

@@ -42,6 +42,7 @@ import traceback
 from collections import deque
 from typing import Any, Dict, Optional
 
+from ..utils.trace import trace_event
 from .device_replay import DeviceEpisodeStage, _lane_sharding
 from .replay import EpisodeStore
 from .trainer import PIPE_EVENT_KEYS, PIPE_STAT_KEYS
@@ -242,8 +243,10 @@ class DeviceBatchPipeline:
                         file=sys.stderr,
                     )
                 time.sleep(0.05)
+            wait = time.perf_counter() - t0
             with self._lock:
-                self._stats["ready_wait_s"] += time.perf_counter() - t0
+                self._stats["ready_wait_s"] += wait
+            trace_event("pipe.ready_wait", wait, plane="pipeline", mode="device")
             if not self._eligible:
                 return None
         if self._sampler is None:

@@ -36,6 +36,8 @@ from typing import Any, Dict, List, Optional
 from ..envs import make_env, prepare_env
 from ..models import init_variables
 from ..parallel import is_coordinator, make_mesh
+from ..utils import trace
+from ..utils.trace import trace_span
 from . import faults
 from .checkpoint import (
     gc_snapshots,
@@ -92,6 +94,16 @@ class Learner:
         self._health = None
         self._collective_watchdog = None
         self._host_faulted = False
+        # -- observability plane (docs/observability.md) ------------------
+        # span tracing arms here, BEFORE any pipeline/trainer construction,
+        # so startup dispatches are in the trace too; configure() validates
+        # the sink is writable (a run asked to trace must fail loudly at
+        # startup).  Off by default: trace_span is then one attribute check
+        if trace.configure(self.args.get("trace"), rank=self._dist_rank):
+            print(f"trace: spans -> {trace.current_path()} (rank {self._dist_rank})")
+        self._rank_metrics = bool(
+            (self.args.get("observability") or {}).get("rank_metrics", True)
+        )
 
         prepare_env(args["env_args"])
         self.env = make_env(args["env_args"])
@@ -524,9 +536,10 @@ class Learner:
 
         epoch, params = self.model_server.latest_snapshot()
         key = jax.random.PRNGKey(self.args["seed"] + 0xE7A1 + self.model_epoch)
-        counts = self._device_eval.evaluate(
-            params, int(self.args["device_eval_games"]), key
-        )
+        with trace_span("eval.device", plane="eval", epoch=self.model_epoch):
+            counts = self._device_eval.evaluate(
+                params, int(self.args["device_eval_games"]), key
+            )
         opponent = "device-" + self._device_eval.opponent
         self.feed_results([
             {"args": {"player": [0], "model_id": {0: epoch}},
@@ -577,7 +590,8 @@ class Learner:
             record["generation_mean"] = mean
             record["generation_std"] = std
 
-        params, steps = self.trainer.update()
+        with trace_span("epoch.snapshot_wait", plane="learner"):
+            params, steps = self.trainer.update()
         if params is None:
             params = self.model_server.latest_params()
         self.update_model(params, steps)
@@ -623,6 +637,19 @@ class Learner:
             # another boundary
             record["dist_processes"] = self._dist_nprocs
             record.update(self._dist_events())
+            if self._health is not None and self._rank_metrics:
+                snap = self._rank_snapshot(steps)
+                if self._dist_follower:
+                    # PR 12 made metrics.jsonl coordinator-only; the
+                    # snapshot rides the next heartbeat ack round so THIS
+                    # rank shows up in the coordinator's rank_* aggregates
+                    self._health.offer_metrics(snap)
+                else:
+                    record.update(self._health.rank_aggregates(snap))
+        if trace.enabled():
+            # tracer health next to the data it may be dropping: a nonzero
+            # trace_dropped means the ring was outrun this run
+            record.update(trace.trace_stats())
         # local refs: a concurrent watchdog degrade nulls these attributes
         # between the None-check and the reads (same hazard as
         # _actor_params) — the epoch record must not die on the very
@@ -665,18 +692,20 @@ class Learner:
             # Every file goes tmp -> fsync -> rename and lands in the CRC
             # manifest, so a crash at ANY instant leaves the previous
             # epoch's resume point intact and verifiable.
-            save_epoch_snapshot(
-                self.model_dir,
-                self.model_epoch,
-                params,
-                self.trainer.save_payload(self.model_epoch),
-                steps,
-            )
-            gc_snapshots(
-                self.model_dir,
-                int(self.args.get("keep_checkpoints", 0)),
-                pin=self._gc_pinned(),
-            )
+            with trace_span("checkpoint.save", plane="learner",
+                            epoch=self.model_epoch):
+                save_epoch_snapshot(
+                    self.model_dir,
+                    self.model_epoch,
+                    params,
+                    self.trainer.save_payload(self.model_epoch),
+                    steps,
+                )
+                gc_snapshots(
+                    self.model_dir,
+                    int(self.args.get("keep_checkpoints", 0)),
+                    pin=self._gc_pinned(),
+                )
         self.model_server.publish(self.model_epoch, params)
 
     def _repair_metrics_tail(self, path: str) -> None:
@@ -719,6 +748,12 @@ class Learner:
             self._metrics_tail_checked = True
             if os.path.exists(path):
                 self._repair_metrics_tail(path)
+        # the ONE timestamp seam: every record carries wall-clock ts (cross
+        # -run/cross-host alignment, absolute) and t_mono (monotonic — rate
+        # math immune to NTP steps), so tooling stops using the record
+        # index as a time axis (scripts/_logparse.py time_axis)
+        record.setdefault("ts", round(time.time(), 6))
+        record.setdefault("t_mono", round(time.monotonic(), 6))
         line = json.dumps(record, default=float) + "\n"
         with open(path, "a") as f:
             f.write(line)
@@ -845,6 +880,18 @@ class Learner:
 
     # -- cross-host fault handling (parallel/health.py) -----------------------
 
+    def _rank_snapshot(self, steps: Optional[int] = None) -> Dict[str, Any]:
+        """This rank's per-epoch metric snapshot for the cross-host relay
+        (parallel/health.py): the fields the coordinator folds into the
+        rank_* aggregates.  Small on purpose — it rides heartbeat lines."""
+        stats = self.trainer.stats or {}
+        return {
+            "epoch": self.model_epoch,
+            "steps": int(self.trainer.steps if steps is None else steps),
+            "train_steps_per_sec": stats.get("train_steps_per_sec"),
+            "input_wait_frac": stats.get("input_wait_frac"),
+        }
+
     def _dist_events(self) -> Dict[str, int]:
         """Cumulative cross-host health counters for the dist_* metrics."""
         health_ev = self._health.events if self._health is not None else {}
@@ -887,6 +934,16 @@ class Learner:
             if is_coordinator():
                 record = {"epoch": self.model_epoch, "dist_processes": self._dist_nprocs}
                 record.update(self._dist_events())
+                if self._health is not None and self._rank_metrics:
+                    # last known per-rank picture rides the final record: a
+                    # wedged-but-heartbeating peer shows up here as a stale
+                    # epoch / grown report age — the post-mortem pointer
+                    try:
+                        record.update(
+                            self._health.rank_aggregates(self._rank_snapshot())
+                        )
+                    except Exception:
+                        pass  # the drain save must land regardless
                 self._write_metrics(record)
                 self._write_drain_checkpoint()
         except Exception:
@@ -1521,6 +1578,7 @@ class Learner:
             if self._collective_watchdog is not None:
                 self._collective_watchdog.stop()
             self._restore_signal_handlers()
+            trace.shutdown()  # flush the span ring tail; a no-op when off
         return EXIT_RESUMABLE if self._drain_requested else 0
 
     @property

@@ -80,6 +80,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..utils.trace import trace_event
 from . import codec
 from .batch import fill_batch, make_batch
 from .connection import _wait_io
@@ -654,8 +655,10 @@ class ShmBatchPipeline:
                 # was already reclaimed and may be refilling right now
             self._owner[slot] = -1
             self._had_death = False  # ring proved itself post-death: disarm
+            wait = time.perf_counter() - t0
             with self._lock:
-                self._stats["ready_wait_s"] += time.perf_counter() - t0
+                self._stats["ready_wait_s"] += wait
+            trace_event("pipe.ready_wait", wait, plane="pipeline", mode="shm")
             return slot, t_sample, t_assemble, t_free
         return None
 

@@ -41,6 +41,7 @@ import jax
 import numpy as np
 
 from ..parallel import TrainContext
+from ..utils.trace import trace_event, trace_span
 from . import faults
 from .batch import make_batch
 from .replay import EpisodeStore
@@ -204,7 +205,9 @@ class BatchPipeline:
     def _host_get_timed(self):
         t0 = time.perf_counter()
         batch = self._get(self._host_queue)
-        self._bump("ready_wait_s", time.perf_counter() - t0)
+        wait = time.perf_counter() - t0
+        self._bump("ready_wait_s", wait)
+        trace_event("pipe.ready_wait", wait, plane="pipeline", mode=self.mode)
         return batch
 
     def _device_put_loop(self):
@@ -769,7 +772,10 @@ class Trainer:
                 if self.stop_event.is_set():
                     break
                 self._replay_key, sub = jax.random.split(self._replay_key)
-                self.state, metrics = train(self.state, sub, self._step_lr(lr, fused))
+                with trace_span("train_step", plane="learner"):
+                    self.state, metrics = train(
+                        self.state, sub, self._step_lr(lr, fused)
+                    )
                 if metric_accum:
                     # graftlint: allow[HS001] reason=deliberate one-deep pipelining: block on update N-1 so the dispatch queue stays shallow and the concurrent rollout thread gets device time
                     jax.block_until_ready(metric_accum[-1]["total"])
@@ -803,6 +809,9 @@ class Trainer:
                 t0 = time.perf_counter()
                 batch = self.batcher.batch()
                 batch_wait = time.perf_counter() - t0
+                # already-measured duration -> span (no second clock read
+                # on the disabled path; trace_event is a no-op there)
+                trace_event("batch.wait", batch_wait, plane="learner")
                 if self._warmup_wait_pending:
                     # first batch of the RUN: the wait covers the assembly
                     # plane's one-off warm-up, and the first train dispatch
@@ -839,10 +848,11 @@ class Trainer:
                 step_lr = self._step_lr(lr, fused)
                 self._arm("train_step @ step %d" % self.steps)
                 try:
-                    if fused > 1:  # k updates per device call, metrics pre-summed
-                        self.state, metrics = self.ctx.train_steps(self.state, batch, step_lr)
-                    else:
-                        self.state, metrics = self.ctx.train_step(self.state, batch, step_lr)
+                    with trace_span("train_step", plane="learner"):
+                        if fused > 1:  # k updates per device call, metrics pre-summed
+                            self.state, metrics = self.ctx.train_steps(self.state, batch, step_lr)
+                        else:
+                            self.state, metrics = self.ctx.train_step(self.state, batch, step_lr)
                 finally:
                     self._disarm()
                 self._collective_dispatched = True
@@ -857,8 +867,9 @@ class Trainer:
 
         self._arm("epoch-end metrics fetch")
         try:
-            # graftlint: allow[HS001] reason=epoch-end fetch of the whole epoch's metrics in one device_get — once per epoch, not per dispatch
-            fetched = jax.device_get(metric_accum)
+            with trace_span("epoch.metrics_fetch", plane="learner"):
+                # graftlint: allow[HS001] reason=epoch-end fetch of the whole epoch's metrics in one device_get — once per epoch, not per dispatch
+                fetched = jax.device_get(metric_accum)
         finally:
             self._disarm()
         skipped_steps = 0

@@ -48,6 +48,7 @@ from ..models.inference import fetch_outputs
 from ..parallel.mesh import dispatch_serialized
 from ..runtime.inference_engine import EngineStopped, next_bucket, stack_padded
 from ..utils import tree_map
+from ..utils.trace import trace_event
 
 __all__ = [
     "ContinuousBatcher", "ServeError", "RequestShed", "DeadlineExceeded",
@@ -418,6 +419,10 @@ class ContinuousBatcher:
         )
         outputs = fetch_outputs(device_out)  # host fetch outside the locks
         done = time.monotonic()
+        # dispatch -> outputs-on-host for this batch; the per-request
+        # "serve.request" span (server.py) brackets admit -> reply around it
+        trace_event("serve.batch", done - t0, t0=t0, plane="serving",
+                    n=n, bucket=bucket)
         self._note_batch(done - t0, bucket)
         with self._gate:
             # the device work is over: a waiter woken by the scatter below

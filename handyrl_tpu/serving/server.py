@@ -43,6 +43,7 @@ from ..runtime.connection import (
     accept_socket_connections,
 )
 from ..runtime.inference_engine import EngineStopped
+from ..utils.trace import trace_event
 from .router import ColdRoute, ModelRouter
 
 __all__ = ["ServingServer", "serve_main"]
@@ -243,11 +244,22 @@ class ServingServer(QueueCommunicator):
                 continue
             break
         fut.add_done_callback(
-            lambda f, c=conn, r=rid, s=served: self._reply(c, r, s, f)
+            lambda f, c=conn, r=rid, s=served, a=arrival:
+                self._reply(c, r, s, f, a)
         )
 
-    def _reply(self, conn: FramedConnection, rid, served, fut) -> None:
+    def _reply(self, conn: FramedConnection, rid, served, fut,
+               arrival: Optional[float] = None) -> None:
         exc = fut.exception()
+        if arrival is not None:
+            # the request lifecycle as one span: frame arrival (admission)
+            # -> queue -> batch dispatch -> this reply callback.  The
+            # nested "serve.batch" span (batcher.py) shows how much of it
+            # was device work vs queueing
+            trace_event(
+                "serve.request", time.monotonic() - arrival, t0=arrival,
+                plane="serving", ok=exc is None,
+            )
         if exc is None:
             with self._stats_lock:
                 self.replies += 1
@@ -344,6 +356,9 @@ class ServingServer(QueueCommunicator):
     def _write_metrics(self, record: Dict[str, Any]) -> None:
         """Learner._write_metrics discipline: one flushed+fsynced append
         per record, so readers tolerate at most a truncated tail line."""
+        # same timestamp seam as the learner's records (ts wall / t_mono)
+        record.setdefault("ts", round(time.time(), 6))
+        record.setdefault("t_mono", round(time.monotonic(), 6))
         line = json.dumps(record, default=float) + "\n"
         with open(self._metrics_path, "a") as f:
             f.write(line)
@@ -364,9 +379,12 @@ def serve_main(args: Dict[str, Any]) -> None:
     snapshot hot-swaps in with zero dropped requests.
     """
     from ..envs import make_env, prepare_env
+    from ..utils import trace
 
     train = args["train_args"]
     env_args = args["env_args"]
+    if trace.configure(train.get("trace")):
+        print(f"serving: trace spans -> {trace.current_path()}")
     prepare_env(env_args)
     env = make_env(env_args)
     module = env.net()

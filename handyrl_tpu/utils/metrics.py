@@ -59,10 +59,20 @@ METRIC_KEYS = frozenset({
     # final pre-exit drain record (runtime/learner.py)
     "dist_processes", "dist_heartbeat_misses", "dist_collective_timeouts",
     "dist_peer_loss_drains",
+    # observability plane (docs/observability.md): every record carries
+    # both clocks from the single _write_metrics seam — ts (wall, absolute
+    # cross-host alignment) and t_mono (monotonic, NTP-step-immune rate
+    # math); readers prefer them over the record index for time axes
+    "ts", "t_mono",
 })
 # key families written from the *_KEYS tuples (trainer/learner) and the
-# per-epoch plane-health diffs; one prefix registers the family
-METRIC_KEY_PREFIXES = ("pipe_", "plane_", "sentinel_")
+# per-epoch plane-health diffs; one prefix registers the family.
+# rank_*: the coordinator's fold of per-rank metric snapshots relayed
+# over health-plane heartbeats (HostHealthPlane.rank_aggregates — min/
+# max/mean of epoch, steps, step rate, input_wait_frac, plus report
+# staleness); trace_*: cumulative tracer health (spans recorded, ring
+# drops) from utils/trace.trace_stats
+METRIC_KEY_PREFIXES = ("pipe_", "plane_", "sentinel_", "rank_", "trace_")
 
 
 def read_metrics(path: str, strict: bool = False) -> List[Dict[str, Any]]:

@@ -86,6 +86,33 @@ def _parse_stdout(path: str) -> List[Dict[str, Any]]:
     return records
 
 
+def time_axis(records: List[Dict[str, Any]]) -> tuple:
+    """(xs, xlabel) for plotting: prefer the records' own clocks over
+    their position in the file.
+
+    Every record since the observability plane carries ``ts`` (wall) and
+    ``t_mono`` (monotonic) from the single ``_write_metrics`` seam —
+    minutes-since-start on those is the honest axis (epochs are not
+    equal-duration, and the record INDEX lies as soon as a resume appends
+    to an old file).  ``t_mono`` wins within one process (immune to NTP
+    steps) but does not survive a resume (each process has its own zero),
+    so it is only used when it is monotone across the whole file; ``ts``
+    is the cross-run fallback.  Files predating both fall back to
+    ``epoch``, then to the record index.
+    """
+    monos = [r.get("t_mono") for r in records]
+    if all(m is not None for m in monos) and monos == sorted(monos) and records:
+        base = monos[0]
+        return [(m - base) / 60.0 for m in monos], "minutes (monotonic)"
+    walls = [r.get("ts") for r in records]
+    if all(w is not None for w in walls) and records:
+        base = walls[0]
+        return [(w - base) / 60.0 for w in walls], "minutes"
+    if all(r.get("epoch") is not None for r in records) and records:
+        return [r["epoch"] for r in records], "epoch"
+    return list(range(len(records))), "record"
+
+
 def smooth(values: List[float], k: int = 5) -> List[float]:
     """Centered moving average, like the reference's smoothing windows."""
     if k <= 1 or len(values) < 3:

@@ -18,7 +18,7 @@ import matplotlib
 matplotlib.use("Agg")
 import matplotlib.pyplot as plt
 
-from _logparse import parse_records, save_or_show, smooth
+from _logparse import parse_records, save_or_show, smooth, time_axis
 
 
 def main() -> None:
@@ -30,7 +30,9 @@ def main() -> None:
         print("no generation-stats records found")
         sys.exit(1)
 
-    xs = [r["epoch"] for r in records]
+    # records carry their own clocks (ts/t_mono) since the observability
+    # plane: a real time axis instead of equal-width epochs
+    xs, xlabel = time_axis(records)
     means = smooth([r["generation_mean"] for r in records])
     fig, ax = plt.subplots(figsize=(8, 5))
     ax.plot(xs, means, label="generation mean")
@@ -39,7 +41,7 @@ def main() -> None:
         lo = [m - s for m, s in zip(means, stds)]
         hi = [m + s for m, s in zip(means, stds)]
         ax.fill_between(xs, lo, hi, alpha=0.2, label="±1 std")
-    ax.set_xlabel("epoch")
+    ax.set_xlabel(xlabel)
     ax.set_ylabel("outcome")
     ax.legend()
     ax.set_title("generation stats")

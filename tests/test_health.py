@@ -190,6 +190,131 @@ def test_heartbeat_roundtrip_and_loss_echo_over_localhost():
     server.close()
 
 
+def test_heartbeat_metrics_piggyback_reaches_the_books():
+    """The cross-host metric relay (observability.rank_metrics): a beat
+    carrying a ``metrics`` snapshot files it under the sender's rank on
+    the coordinator — no second transport, no extra round-trips."""
+    import json
+
+    plane = _plane(lambda r, k: None, time.monotonic, interval=0.2, timeout=2.0)
+    a, b = socket.socketpair()
+    t = threading.Thread(target=plane._serve_peer, args=(b,), daemon=True)
+    t.start()
+    try:
+        a.settimeout(2.0)
+        snap = {"epoch": 4, "steps": 120, "train_steps_per_sec": 8.5,
+                "input_wait_frac": 0.02}
+        a.sendall(json.dumps({"rank": 1, "seq": 1, "metrics": snap}).encode()
+                  + b"\n")
+        assert b"\n" in a.recv(4096)
+        deadline = time.monotonic() + 2.0
+        while 1 not in plane.peer_metrics and time.monotonic() < deadline:
+            time.sleep(0.01)
+        filed, _at = plane.peer_metrics[1]
+        assert filed == snap
+    finally:
+        plane._stop.set()
+        a.close()
+
+
+def test_follower_offer_rides_next_beat_and_survives_a_failed_send():
+    """offer_metrics queues newest-wins; a send failure restores the
+    snapshot unless a newer one was offered meanwhile."""
+    plane = _plane(lambda r, k: None, _Clock(), rank=1)
+    plane.offer_metrics({"epoch": 1})
+    plane.offer_metrics({"epoch": 2})          # newest wins
+    taken = plane._take_pending_metrics()
+    assert taken == {"epoch": 2}
+    assert plane._take_pending_metrics() is None
+    plane._restore_pending_metrics(taken)      # the send failed: keep it
+    assert plane._take_pending_metrics() == {"epoch": 2}
+    plane._restore_pending_metrics(taken)
+    plane.offer_metrics({"epoch": 3})
+    plane._restore_pending_metrics({"epoch": 2})  # older loser must NOT clobber
+    assert plane._take_pending_metrics() == {"epoch": 3}
+
+
+def test_rank_aggregates_fold_every_rank():
+    clock = _Clock()
+    plane = _plane(lambda r, k: None, clock, interval=1.0, nprocs=3)
+    plane.note_peer_metrics(1, {"epoch": 3, "steps": 90,
+                                "train_steps_per_sec": 10.0,
+                                "input_wait_frac": 0.3}, now=clock())
+    plane.note_peer_metrics(2, {"epoch": 3, "steps": 90,
+                                "train_steps_per_sec": 20.0,
+                                "input_wait_frac": 0.1}, now=clock())
+    agg = plane.rank_aggregates(
+        {"epoch": 3, "steps": 90, "train_steps_per_sec": 30.0,
+         "input_wait_frac": 0.2},
+    )
+    assert agg["rank_reports"] == 3
+    assert agg["rank_missing_reports"] == 0
+    assert agg["rank_epoch_min"] == agg["rank_epoch_max"] == 3
+    assert agg["rank_train_steps_per_sec_min"] == 10.0
+    assert agg["rank_train_steps_per_sec_max"] == 30.0
+    assert agg["rank_train_steps_per_sec_mean"] == 20.0
+    assert agg["rank_input_wait_frac_max"] == 0.3
+    assert agg["rank_report_age_s_max"] == 0.0
+    assert agg["rank_stale_reports"] == 0
+
+
+def test_wedged_but_heartbeating_follower_visible_before_watchdog_bound():
+    """Acceptance pin (socket-free): a follower whose TRAINER wedges keeps
+    heartbeating — the liveness plane sees nothing wrong — but its metric
+    snapshot stops advancing, so the coordinator's rank aggregates flag it
+    (stale report age, frozen epoch/steps): long before a
+    collective_timeout (minutes) fires."""
+    clock = _Clock()
+    events = []
+    plane = _plane(lambda r, k: events.append(k), clock,
+                   interval=1.0, timeout=30.0, nprocs=2)
+    plane._started_at = clock()
+    # three healthy boundaries at a ~1s epoch cadence: beat AND snapshot
+    # arrive each time; the aggregation-period EMA learns the cadence
+    for epoch in (1, 2, 3):
+        plane.last_seen[1] = clock()
+        plane.note_peer_metrics(1, {"epoch": epoch, "steps": 30 * epoch,
+                                    "train_steps_per_sec": 9.0}, now=clock())
+        agg = plane.rank_aggregates({"epoch": epoch, "steps": 30 * epoch,
+                                     "train_steps_per_sec": 9.1})
+        assert agg["rank_stale_reports"] == 0
+        clock.t += 1.0
+    # rank 1's trainer wedges; its health thread keeps beating for 10s
+    # (well inside heartbeat_timeout 30 and any collective_timeout) but
+    # no further snapshot ever arrives
+    for _ in range(10):
+        clock.t += 1.0
+        plane.last_seen[1] = clock()
+    assert plane.check_peers() is None          # liveness plane: all good
+    assert events == []                          # no fault declared
+    # ...but the fold (a later boundary, or the host-fault record) judges
+    # rank 1's report against the HEALTHY cadence and flags it
+    agg = plane.rank_aggregates({"epoch": 4, "steps": 120,
+                                 "train_steps_per_sec": 9.1})
+    assert agg["rank_report_age_s_max"] == 11.0
+    assert agg["rank_stale_reports"] == 1
+    assert agg["rank_epoch_min"] == 3 and agg["rank_epoch_max"] == 4
+    assert agg["rank_steps_min"] == 90 and agg["rank_steps_max"] == 120
+
+
+def test_healthy_long_epochs_are_not_flagged_stale():
+    """The inverse pin: snapshots arrive once per EPOCH, so a follower one
+    minute-long boundary behind is the healthy steady state — the stale
+    bound must track the observed cadence, not the 5s beat interval."""
+    clock = _Clock()
+    plane = _plane(lambda r, k: None, clock, interval=5.0, nprocs=2)
+    for epoch in (1, 2, 3, 4):
+        # the fold at boundary N sees the follower's boundary-(N-1)
+        # snapshot: one minute old, which is exactly on-cadence
+        agg = plane.rank_aggregates({"epoch": epoch, "steps": 10 * epoch})
+        assert agg["rank_stale_reports"] == 0, epoch
+        if epoch >= 3:  # cadence EMA warmed: the 60s age was judged
+            assert agg["rank_report_age_s_max"] == 60.0
+        plane.note_peer_metrics(1, {"epoch": epoch, "steps": 10 * epoch},
+                                now=clock())
+        clock.t += 60.0  # minute-long epochs dwarf 3x heartbeat_interval
+
+
 def test_wedge_stops_heartbeats_without_teardown():
     plane = _plane(lambda r, k: None, _Clock(), rank=1)
     assert plane._beat.is_set()

@@ -546,13 +546,27 @@ def test_two_process_learner_epoch_loop(tmp_path):
     """Acceptance pin (non-slow, multihost CI step): a REAL 2-process
     Learner run completes 2 epochs under jax.distributed with params
     bit-identical on both processes, checkpoints/metrics written only by
-    the coordinator, and a clean exit-0 shutdown on every rank."""
+    the coordinator, and a clean exit-0 shutdown on every rank.
+
+    The run is TRACE-ENABLED (observability acceptance): each rank must
+    write its own span file whose Perfetto export round-trips, and the
+    coordinator's metrics.jsonl must carry rank_* aggregates covering
+    BOTH ranks — the follower's snapshots arrive over the heartbeat
+    relay, since PR 12 made metrics coordinator-only."""
     import numpy as np
 
     # generous heartbeat bound: this test pins the lockstep loop, not
     # detection latency, and a CI box under full-suite load can starve a
     # health thread for several seconds at a stretch
-    procs = _spawn_learners(tmp_path, extra={"epochs": 2, "heartbeat_timeout": 45.0})
+    procs = _spawn_learners(tmp_path, extra={
+        "epochs": 2,
+        "heartbeat_timeout": 45.0,
+        "train": {"trace": {
+            "enabled": True,
+            "path": str(tmp_path / "trace.jsonl"),
+            "flush_interval": 0.2,
+        }},
+    })
     outs = [p.communicate(timeout=420)[0].decode(errors="replace") for p in procs]
     codes = [p.returncode for p in procs]
     assert codes == [0, 0], "".join(
@@ -588,6 +602,51 @@ def test_two_process_learner_epoch_loop(tmp_path):
     assert len(records) >= 2
     assert records[-1].get("dist_processes") == 2
     assert records[-1].get("dist_peer_loss_drains") == 0
+
+    # every record carries the timestamp seam (the plot scripts' time axis)
+    assert all("ts" in r and "t_mono" in r for r in records)
+
+    # cross-host visibility (acceptance): some boundary record folds BOTH
+    # ranks — the follower's per-epoch snapshot rode a heartbeat and the
+    # coordinator aggregated it.  The first boundary may legitimately
+    # precede the follower's first beat; a full run must not
+    full = [r for r in records if r.get("rank_reports") == 2]
+    assert full, [
+        {k: v for k, v in r.items() if k.startswith("rank_")} for r in records
+    ]
+    last = full[-1]
+    assert last["rank_missing_reports"] == 0
+    assert last["rank_steps_min"] > 0
+    assert last["rank_train_steps_per_sec_min"] > 0
+
+    # trace-enabled run: one span file per rank (rank 1 derives its own
+    # path), both parseable, and the merged Perfetto export round-trips
+    from handyrl_tpu.utils.trace import read_trace
+
+    trace0 = read_trace(str(tmp_path / "trace.jsonl"))
+    trace1 = read_trace(str(tmp_path / "trace.rank1.jsonl"))
+    names0 = {r["name"] for r in trace0}
+    assert "train_step" in names0, sorted(names0)
+    assert "cadence.agree_step" in names0, sorted(names0)
+    assert "checkpoint.save" in names0, sorted(names0)
+    assert {r["name"] for r in trace1} & {"cadence.agree_step", "train_step"}
+    assert any(r["name"] == "health.heartbeat" for r in trace1)
+    scripts = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    )
+    sys.path.insert(0, scripts)
+    try:
+        from trace_export import export_chrome
+    finally:
+        sys.path.remove(scripts)
+    out = export_chrome([trace0, trace1])
+    xs = [e for e in out["traceEvents"] if e["ph"] == "X"]
+    n_spans = sum(
+        1 for recs in (trace0, trace1) for r in recs
+        if r["name"] != "__trace_meta__"
+    )
+    assert len(xs) == n_spans and n_spans > 0
+    assert {e["pid"] for e in xs} == {0, 1}  # both ranks on one timeline
 
 
 # the resume-epoch broadcast (the non-coordinator auto-resume fix): the

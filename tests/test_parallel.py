@@ -144,10 +144,19 @@ def test_param_shardings_mp_axis():
 
 
 def _env_batch(env_args, train_overrides):
+    import random
+
     from handyrl_tpu.config import normalize_args
     from handyrl_tpu.envs import make_env
     from handyrl_tpu.models import InferenceModel, RandomModel, init_variables
     from handyrl_tpu.runtime import EpisodeStore, Generator, make_batch
+
+    # pin the GLOBAL random stream: episode generation below draws from
+    # it, and inheriting whatever state earlier in-process tests left
+    # (learner/league e2es make a timing-dependent number of draws)
+    # makes the numeric-tolerance tests downstream load-flaky — the bf16
+    # delta bound was observed failing only under full-suite load
+    random.seed(20260804)
 
     cfg = normalize_args(
         {

@@ -115,7 +115,10 @@ def test_write_metrics_is_one_flushed_line_per_record(tmp_path):
 
     for epoch in (1, 2):
         Learner._write_metrics(Stub(), {"epoch": epoch, "win_rate": None})
-        assert read_metrics(path)[-1] == {"epoch": epoch, "win_rate": None}
+        last = read_metrics(path)[-1]
+        # the single timestamp seam stamps both clocks onto every record
+        assert last.pop("ts") > 0 and last.pop("t_mono") > 0
+        assert last == {"epoch": epoch, "win_rate": None}
     assert len(read_metrics(path)) == 2
 
 
@@ -138,10 +141,11 @@ def test_resumed_run_repairs_truncated_metrics_tail(tmp_path):
     stub = Stub()  # fresh process: tail check re-arms
     Learner._write_metrics(stub, {"epoch": 2})
     Learner._write_metrics(stub, {"epoch": 3})
-    # strict: NO invalid line survives anywhere in the file
-    assert read_metrics(path, strict=True) == [
-        {"epoch": 1}, {"epoch": 2}, {"epoch": 3}
-    ]
+    # strict: NO invalid line survives anywhere in the file (the appended
+    # records additionally carry the ts/t_mono timestamp seam)
+    records = read_metrics(path, strict=True)
+    assert [r["epoch"] for r in records] == [1, 2, 3]
+    assert all("ts" in r and "t_mono" in r for r in records[1:])
 
 
 # ----------------------------------------------------- in-step finite check

@@ -1,0 +1,306 @@
+"""Observability plane: the span tracer, its exporter, and the
+zero-overhead pin (docs/observability.md).
+
+The contract under test, in priority order:
+
+1. **Provably free when off** — `trace_span` disabled returns ONE shared
+   no-op object (no allocation), and a real `batch_pipeline: device`
+   window with tracing off records ZERO blocking host syncs and ZERO XLA
+   recompiles under the PR 9 sanitizers: the instrumentation cannot have
+   added a hot-path cost it claims not to have.
+2. **Never blocking when on** — a full span ring DROPS and counts
+   (`trace_dropped`), the flusher drains in the background, and a
+   trace-enabled window still shows zero recompiles (spans are host-side
+   bookkeeping, not device work).
+3. **Crash-tolerant** — `read_trace` tolerates exactly one truncated
+   FINAL line; mid-file corruption raises.
+4. **Exportable** — the Chrome/Perfetto exporter's mapping is pinned by
+   a committed golden (regenerate intentionally with
+   HANDYRL_REGEN_GOLDEN=1).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.obs
+
+from handyrl_tpu.utils import trace as trace_mod
+from handyrl_tpu.utils.trace import (
+    META_NAME,
+    read_trace,
+    trace_event,
+    trace_span,
+    trace_stats,
+)
+
+GOLDEN_DIR = Path(__file__).parent / "golden"
+SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts")
+
+
+@pytest.fixture(autouse=True)
+def _tracer_reset():
+    """Every test leaves the process tracer disarmed (the module singleton
+    is process-global state shared with any Learner the suite builds)."""
+    trace_mod.shutdown()
+    yield
+    trace_mod.shutdown()
+
+
+def _configure(tmp_path, rank=0, **over):
+    cfg = {"enabled": True, "path": str(tmp_path / "trace.jsonl"),
+           "ring_size": 4096, "flush_interval": 0.05}
+    cfg.update(over)
+    assert trace_mod.configure(cfg, rank=rank)
+    return trace_mod.current_path()
+
+
+# -- disabled path ------------------------------------------------------------
+
+
+def test_disabled_span_is_one_shared_noop_object():
+    """The disabled fast path allocates nothing: every call returns the
+    SAME context-manager instance, and nothing is recorded."""
+    a = trace_span("x", plane="learner")
+    b = trace_span("y")
+    assert a is b
+    with a:
+        pass
+    trace_event("z", 0.5)
+    assert trace_stats() == {"trace_spans": 0, "trace_dropped": 0}
+
+
+def test_unwritable_sink_fails_at_configure_naming_the_knob(tmp_path):
+    """A run ASKED to trace must fail at startup, not record nothing."""
+    with pytest.raises(ValueError, match="trace.path"):
+        trace_mod.configure({
+            "enabled": True,
+            "path": str(tmp_path / "no" / "such" / "dir" / "t.jsonl"),
+        })
+    assert not trace_mod.enabled()
+
+
+# -- enabled recording --------------------------------------------------------
+
+
+def test_span_nesting_and_attribution(tmp_path):
+    path = _configure(tmp_path)
+    with trace_span("outer", plane="learner"):
+        with trace_span("inner", step=3):
+            time.sleep(0.01)
+
+    done = threading.Event()
+
+    def worker():
+        with trace_span("threaded"):
+            pass
+        done.set()
+
+    threading.Thread(target=worker, name="obs-worker", daemon=True).start()
+    assert done.wait(5.0)
+    trace_mod.shutdown()
+
+    recs = {r["name"]: r for r in read_trace(path) if r["name"] != META_NAME}
+    assert set(recs) == {"outer", "inner", "threaded"}
+    outer, inner = recs["outer"], recs["inner"]
+    # temporal containment: the nested span lies inside its parent
+    assert outer["t_mono"] <= inner["t_mono"]
+    assert inner["t_mono"] + inner["dur_s"] <= outer["t_mono"] + outer["dur_s"] + 1e-6
+    assert inner["dur_s"] >= 0.01
+    assert inner["attrs"] == {"step": 3}
+    assert outer["attrs"] == {"plane": "learner"}
+    # attribution: thread name + rank ride every record
+    assert recs["threaded"]["thread"] == "obs-worker"
+    assert all(r["rank"] == 0 for r in recs.values())
+    # the wall<->monotonic anchor is the file's first line
+    first = read_trace(path)[0]
+    assert first["name"] == META_NAME and first["version"] >= 1
+
+
+def test_ring_overflow_drops_counted_never_blocking(tmp_path):
+    _configure(tmp_path, ring_size=8, flush_interval=999.0)  # flusher idle
+    t0 = time.perf_counter()
+    for _ in range(100):
+        trace_event("spam", 0.001)
+    elapsed = time.perf_counter() - t0
+    stats = trace_stats()
+    assert stats["trace_spans"] == 8
+    assert stats["trace_dropped"] == 92
+    # 100 drops in well under a flush interval: the full ring never blocks
+    assert elapsed < 1.0
+
+
+def test_rank_suffix_path_derivation(tmp_path):
+    path = _configure(tmp_path, rank=2)
+    assert path.endswith("trace.rank2.jsonl")
+    with trace_span("s"):
+        pass
+    trace_mod.shutdown()
+    recs = read_trace(path)
+    assert all(r["rank"] == 2 for r in recs)
+
+
+# -- crash tolerance ----------------------------------------------------------
+
+
+def test_truncated_tail_tolerated_mid_file_raises(tmp_path):
+    path = _configure(tmp_path)
+    for i in range(3):
+        trace_event(f"s{i}", 0.001)
+    trace_mod.shutdown()
+    # a kill mid-append leaves a half-written FINAL line: tolerated
+    with open(path, "a") as f:
+        f.write('{"name": "torn", "ts": 1.0, "dur_')
+    recs = read_trace(path)
+    assert [r["name"] for r in recs if r["name"] != META_NAME] == ["s0", "s1", "s2"]
+    # but corruption anywhere EARLIER is a real integrity failure
+    lines = open(path).read().splitlines()
+    lines[1] = lines[1][: len(lines[1]) // 2]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(ValueError):
+        read_trace(path)
+
+
+# -- Perfetto export ----------------------------------------------------------
+
+
+def _export_chrome():
+    sys.path.insert(0, SCRIPTS)
+    try:
+        from trace_export import export_chrome
+    finally:
+        sys.path.remove(SCRIPTS)
+    return export_chrome
+
+
+def test_perfetto_export_matches_golden():
+    """The exporter's mapping (event shape, cross-rank wall alignment,
+    deterministic tid assignment) is pinned by a committed golden built
+    from the fixture files; regenerate with HANDYRL_REGEN_GOLDEN=1."""
+    export_chrome = _export_chrome()
+    record_lists = [
+        read_trace(str(GOLDEN_DIR / "trace_fixture.jsonl")),
+        read_trace(str(GOLDEN_DIR / "trace_fixture_rank1.jsonl")),
+    ]
+    out = export_chrome(record_lists)
+    golden_path = GOLDEN_DIR / "trace_perfetto.json"
+    if os.environ.get("HANDYRL_REGEN_GOLDEN"):
+        golden_path.write_text(json.dumps(out, indent=1) + "\n")
+        pytest.skip("golden regenerated; commit tests/golden/ and re-run")
+    assert out == json.loads(golden_path.read_text()), (
+        "Perfetto export drifted from the committed golden; if intentional, "
+        "regenerate with HANDYRL_REGEN_GOLDEN=1"
+    )
+
+
+def test_real_trace_round_trips_through_the_exporter(tmp_path):
+    """write -> read_trace -> export: every recorded span becomes exactly
+    one complete ('X') event with in-range timestamps."""
+    path = _configure(tmp_path)
+    with trace_span("a", plane="learner"):
+        with trace_span("b"):
+            pass
+    trace_event("c", 0.01, plane="pipeline")
+    trace_mod.shutdown()
+    export_chrome = _export_chrome()
+    recs = read_trace(path)
+    out = export_chrome([recs])
+    xs = [e for e in out["traceEvents"] if e["ph"] == "X"]
+    assert sorted(e["name"] for e in xs) == ["a", "b", "c"]
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+    assert {e["cat"] for e in xs} == {"learner", "pipeline", "trace"}
+
+
+def test_export_cli_writes_chrome_trace(tmp_path):
+    import subprocess
+
+    path = _configure(tmp_path)
+    with trace_span("cli_span"):
+        pass
+    trace_mod.shutdown()
+    out_path = tmp_path / "export.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "trace_export.py"), path,
+         "-o", str(out_path)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    data = json.loads(out_path.read_text())
+    assert any(e["name"] == "cli_span" for e in data["traceEvents"])
+
+
+# -- the zero-overhead pin (acceptance) ---------------------------------------
+
+
+def _pipeline_window():
+    """One warm batch_pipeline: device window (the test_sanitizers
+    surface): pipeline batch() sampling dispatches + real train steps."""
+    # tests/ is on sys.path under pytest's rootdir insertion (no
+    # tests/__init__.py), same mechanism the scripts use for _logparse
+    from test_sanitizers import _device_pipeline
+
+    return _device_pipeline(dp=2)
+
+
+@pytest.mark.slow
+def test_trace_disabled_window_is_sync_and_recompile_free():
+    """Acceptance pin: with `trace: false` (the default) the instrumented
+    hot path — dispatch_serialized spans, pipeline wait events, train-step
+    spans all compiled IN but disarmed — adds ZERO blocking host syncs and
+    ZERO XLA recompiles to a warm streaming window.  This is the harness
+    that keeps 'off by default and provably free' true."""
+    from handyrl_tpu.utils.sanitizers import HostSyncSanitizer, RecompileSentinel
+
+    assert not trace_mod.enabled()
+    pipe, ctx, state, stop = _pipeline_window()
+    try:
+        batch = pipe.batch()  # warm: ring init + sampler jit
+        assert batch is not None
+        state, _ = ctx.train_step(state, batch, 1e-5)
+        with HostSyncSanitizer() as sync, RecompileSentinel() as sentinel:
+            for _ in range(4):
+                batch = pipe.batch()
+                assert batch is not None
+                state, _ = ctx.train_step(state, batch, 1e-5)
+        sync.assert_clean("trace: false device-pipeline window")
+        sentinel.assert_no_recompiles("trace: false device-pipeline window")
+    finally:
+        stop.set()
+        pipe.stop()
+
+
+@pytest.mark.slow
+def test_trace_enabled_window_records_spans_without_recompiles(tmp_path):
+    """Arming the tracer must not change the compiled program either: the
+    same warm window records the dispatch/train/pipe spans and still
+    shows zero XLA recompiles (spans are host bookkeeping, not device
+    work)."""
+    from handyrl_tpu.utils.sanitizers import RecompileSentinel
+
+    pipe, ctx, state, stop = _pipeline_window()
+    try:
+        batch = pipe.batch()
+        assert batch is not None
+        state, _ = ctx.train_step(state, batch, 1e-5)
+        path = _configure(tmp_path)
+        with RecompileSentinel() as sentinel:
+            for _ in range(4):
+                batch = pipe.batch()
+                assert batch is not None
+                state, _ = ctx.train_step(state, batch, 1e-5)
+        trace_mod.shutdown()
+        sentinel.assert_no_recompiles("trace: true device-pipeline window")
+        names = {r["name"] for r in read_trace(path)}
+        # the window's seams all reported: the per-dispatch spans and the
+        # pipeline's measured waits
+        assert "dispatch.run" in names, names
+        assert "dispatch.wait" in names, names
+    finally:
+        stop.set()
+        pipe.stop()

@@ -225,6 +225,10 @@ class LintConfig:
         # a stray sync here is a per-step cross-host stall
         "handyrl_tpu/parallel/distributed.py",
         "handyrl_tpu/parallel/health.py",
+        # the tracer's span/record path runs INSIDE every instrumented
+        # hot seam (dispatch_serialized, batch waits, cadence): a host
+        # sync here would be charged to every dispatch in the repo
+        "handyrl_tpu/utils/trace.py",
     )
     # functions (bare names) that are drain/teardown/construction paths —
     # host syncs there are the POINT, not a leak
@@ -258,6 +262,9 @@ class LintConfig:
         # mesh with the train step: same lock discipline as every dispatch
         "handyrl_tpu/parallel/distributed.py",
         "handyrl_tpu/parallel/health.py",
+        # the tracer must never dispatch device programs at all — any jit
+        # call appearing here is a bug, and DL002 makes it lock-scoped
+        "handyrl_tpu/utils/trace.py",
     )
     dispatch_wrapper: str = "dispatch_serialized"
 
@@ -267,7 +274,8 @@ class LintConfig:
     # dict-valued defaults whose CHILDREN are the knobs (worker.entry_port);
     # every other dict-valued default (mesh, ...) is one knob
     cfg005_nested: Tuple[str, ...] = (
-        "worker", "distributed", "eval", "serving", "league",
+        "worker", "distributed", "eval", "serving", "league", "trace",
+        "observability",
     )
     # documented spellings that are intentionally not defaults (aliases
     # normalized away before validation)
