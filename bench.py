@@ -1794,6 +1794,330 @@ def _serving_bench(duration: float):
     return out
 
 
+# ---------------------------------------------------------------------------
+# fleet: the serving tier behind one router front (docs/serving.md §Fleet)
+# ---------------------------------------------------------------------------
+
+# stateful load geometry: each connection keeps one request outstanding
+# per open session (the honest shape of recurrent traffic — a session's
+# steps are serial by definition; concurrency comes from session count)
+FLEET_CLIENTS = 4 if QUICK else 6
+FLEET_SESSIONS = 8                    # sessions (and window) per connection
+FLEET_RATIO_STEPS = 16                # serial steps for the wire-bytes legs
+
+
+def _fleet_replica_main(pipe, env_name, seed, cfg):
+    """Spawn-context entry for one bench replica: a full serving plane in
+    its OWN process (the scaling leg measures tier throughput — replicas
+    sharing the parent's interpreter would share its GIL and measure
+    nothing).  Reports the bound port over the pipe, then blocks until
+    the parent sends anything (kill-safe: daemon + terminate backstop)."""
+    import os as _os
+
+    _os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.models import init_variables
+    from handyrl_tpu.serving import ModelRouter, ServingServer
+
+    env = make_env({"env": env_name})
+    module = env.net()
+    env.reset()
+    obs = env.observation(env.players()[0])
+    # seeded init: every replica builds IDENTICAL params, so balanced /
+    # re-routed traffic is bit-comparable without shipping weights around
+    params = init_variables(module, env, seed=seed)["params"]
+    router = ModelRouter(module, obs, cfg, model_dir=".")
+    router.publish(1, params)
+    server = ServingServer(router, cfg).run()
+    pipe.send(server.bound_port)
+    try:
+        pipe.recv()
+    except EOFError:
+        pass
+    server.shutdown()
+
+
+def _fleet_router_main(pipe, fleet_cfg):
+    """Spawn-context entry for the fleet router front: its own process,
+    like every other tier component — the scaling leg is only a
+    measurement of the REPLICAS if the router's frame proxying does not
+    share an interpreter (a GIL) with the load generators."""
+    import os as _os
+
+    _os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from handyrl_tpu.fleet import FleetRouter
+
+    fleet = FleetRouter(fleet_cfg).run(connect_timeout=600.0)
+    pipe.send(fleet.bound_port)
+    try:
+        pipe.recv()
+    except EOFError:
+        pass
+    fleet.shutdown()
+
+
+def _fleet_load_main(pipe, port, env_name, dur, sessions, collect_models):
+    """Spawn-context entry for one load generator: one connection driving
+    ``sessions`` server-resident sessions, each with its one in-order
+    request outstanding (a session's steps are serial by definition —
+    concurrency comes from session count).  Handshakes ready/go over the
+    pipe so every generator's window opens together, then reports its
+    own counts and elapsed."""
+    import os as _os
+
+    _os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import time as _time
+
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.serving import ServingClient
+
+    env = make_env({"env": env_name})
+    env.reset()
+    obs = env.observation(env.players()[0])
+    client = ServingClient("127.0.0.1", port)
+    ok = err = 0
+    models = set()
+    try:
+        sids = [client.open_session() for _ in range(sessions)]
+        inflight = [(sid, client.submit(obs, sid=sid)) for sid in sids]
+        pipe.send("ready")
+        pipe.recv()
+        t0 = _time.perf_counter()
+        end = t0 + dur
+        while _time.perf_counter() < end:
+            sid, fut = inflight.pop(0)
+            try:
+                reply = fut.result(timeout=120)
+                ok += 1
+                if collect_models:
+                    models.add(reply["model"])
+            except Exception:
+                err += 1
+            inflight.append((sid, client.submit(obs, sid=sid)))
+        for _sid, fut in inflight:
+            try:
+                reply = fut.result(timeout=120)
+                ok += 1
+                if collect_models:
+                    models.add(reply["model"])
+            except Exception:
+                err += 1
+        elapsed = _time.perf_counter() - t0
+        for sid in sids:
+            client.close_session(sid)
+        pipe.send({"ok": ok, "err": err, "elapsed": elapsed,
+                   "models": sorted(models)})
+    finally:
+        client.close()
+
+
+def _fleet_bench(duration: float):
+    """Fleet-tier bench over real processes and sockets: saturation QPS
+    through the router with one vs two replica processes (the tier must
+    SCALE, not just route), a fleet-wide hot-swap under load with a
+    zero-drop count, and the session leg's wire-bytes ratio vs
+    ship-hidden-state with bit-identical outputs (the session cache must
+    be a pure wire optimization, not a numerics change).
+
+    Every tier component runs in its OWN spawn process — N replicas, the
+    router, and each load generator — so the replicas are the measured
+    bottleneck and the scaling leg reflects tier capacity, not the bench
+    parent's GIL.  The leg is still physics-bound by the host: on a
+    single-core box two replicas CANNOT beat one (``cores`` lands in the
+    result so captures are interpreted against the hardware)."""
+    import multiprocessing as _mp
+    import threading as _threading
+
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.models import init_variables
+    from handyrl_tpu.serving import ServingClient
+
+    # Geister: the DRC ConvLSTM policy — per-step recurrent state (~27 KB)
+    # dwarfs the observation (~1 KB), which is the whole case for server-
+    # resident sessions; its compute is heavy enough that the replicas,
+    # not the router's Python front, are the tier's bottleneck
+    env = make_env({"env": "Geister"})
+    module = env.net()
+    env.reset()
+    obs = env.observation(env.players()[0])
+    p2 = init_variables(module, env, seed=2)["params"]
+    hidden0 = module.initial_state(())  # the same zeros a fresh session gets
+
+    replica_cfg = {
+        "port": 0, "max_models": 4, "slo_ms": 1000.0, "shed_policy": "none",
+        "max_batch": 32, "max_wait_ms": 1.0,
+        # all reachable buckets pre-warmed (startup AND the swap standby):
+        # the zero-drop leg must never pay a hot-path compile
+        "warm_buckets": [1, 2, 4, 8, 16, 32],
+        "queue_bound": 8192, "recv_timeout": 0.0, "watch_interval": 0.0,
+        "stats_interval": 0.0, "session_capacity": 4096, "session_spill": 4096,
+    }
+    fleet_cfg = {
+        "port": 0, "stats_poll_s": 0.5, "replica_stall_s": 60.0,
+        "rejoin_backoff_s": 0.5, "rejoin_backoff_max_s": 5.0,
+        "stats_interval": 0.0,
+    }
+
+    ctx = _mp.get_context("spawn")  # kill-safe: no forked jax runtime state
+    procs = []
+
+    def start(target, *args):
+        parent, child = ctx.Pipe()
+        proc = ctx.Process(target=target, args=(child,) + args, daemon=True)
+        proc.start()
+        procs.append((proc, parent))
+        return proc, parent
+
+    def start_replica():
+        _proc, parent = start(_fleet_replica_main, "Geister", 1, replica_cfg)
+        if not parent.poll(600):
+            raise RuntimeError("fleet bench replica never reported its port")
+        return parent.recv()
+
+    def start_router(ports):
+        cfg = dict(fleet_cfg, replicas=[f"127.0.0.1:{p}" for p in ports])
+        _proc, parent = start(_fleet_router_main, cfg)
+        if not parent.poll(600):
+            raise RuntimeError("fleet bench router never reported its port")
+        return parent.recv(), parent
+
+    def run_load(port, dur, n_clients, collect_models=False, on_go=None):
+        gens = [
+            start(_fleet_load_main, port, "Geister", dur, FLEET_SESSIONS,
+                  collect_models)
+            for _ in range(n_clients)
+        ]
+        # two-phase start: every generator opens its sessions and primes
+        # its window FIRST, then all windows open together on "go" — the
+        # measured interval never includes a generator's jax import
+        for _proc, parent in gens:
+            if not parent.poll(600):
+                raise RuntimeError("fleet bench load generator never primed")
+            parent.recv()
+        for _proc, parent in gens:
+            parent.send("go")
+        if on_go is not None:
+            on_go()
+        results = []
+        for proc, parent in gens:
+            if not parent.poll(dur + 600):
+                raise RuntimeError("fleet bench load generator hung")
+            results.append(parent.recv())
+            proc.join(timeout=60)
+        ok = sum(r["ok"] for r in results)
+        err = sum(r["err"] for r in results)
+        elapsed = max(r["elapsed"] for r in results)
+        models = set().union(*(set(r["models"]) for r in results))
+        return ok / max(elapsed, 1e-6), ok, err, models
+
+    out = {"clients": FLEET_CLIENTS, "sessions": FLEET_SESSIONS,
+           # the scaling leg is physics-bound by the host: on one core two
+           # replica processes cannot beat one, so captures carry the count
+           "cores": os.cpu_count()}
+    try:
+        # -- one replica up; router (own process) over it ------------------
+        port_a = start_replica()
+        r1_port, r1_pipe = start_router([port_a])
+
+        # -- wire-bytes leg: ship-state vs session, serial, bit-compared ---
+        client = ServingClient("127.0.0.1", r1_port)
+        try:
+            import numpy as _np
+
+            from handyrl_tpu.utils import tree_map as _tree_map
+
+            hidden = _tree_map(_np.asarray, hidden0)
+            shipped = []
+            b_sent, b_recv = client.wire_bytes()
+            for _ in range(FLEET_RATIO_STEPS):
+                reply = client.infer(obs, hidden=hidden, timeout=300)
+                hidden = reply["out"].pop("hidden")
+                shipped.append(reply["out"])
+            ship_bytes = sum(
+                a - b for a, b in zip(client.wire_bytes(), (b_sent, b_recv))
+            )
+            sid = client.open_session()
+            b_sent, b_recv = client.wire_bytes()
+            sessioned = []
+            for _ in range(FLEET_RATIO_STEPS):
+                reply = client.infer(obs, sid=sid, timeout=300)
+                sessioned.append(reply["out"])
+            sess_bytes = sum(
+                a - b for a, b in zip(client.wire_bytes(), (b_sent, b_recv))
+            )
+            client.close_session(sid)
+            bitident = all(
+                set(a) == set(b) and all(
+                    _np.array_equal(_np.asarray(a[k]), _np.asarray(b[k]))
+                    for k in a
+                )
+                for a, b in zip(shipped, sessioned)
+            )
+            out["session_wire_ratio"] = ship_bytes / max(sess_bytes, 1)
+            out["session_bitident"] = bitident
+            out["ship_bytes_per_req"] = ship_bytes // FLEET_RATIO_STEPS
+            out["session_bytes_per_req"] = sess_bytes // FLEET_RATIO_STEPS
+        finally:
+            client.close()
+
+        # -- saturation through the router, 1 replica ----------------------
+        qps_1, ok_1, err_1, _ = run_load(r1_port, duration, FLEET_CLIENTS)
+        out["qps_1"] = qps_1
+        out["requests_1"] = ok_1
+        out["load_errors"] = err_1
+        try:
+            r1_pipe.send("stop")
+        except (BrokenPipeError, OSError):
+            pass
+
+        # -- second replica; same load through a 2-replica tier ------------
+        port_b = start_replica()
+        r2_port, _r2_pipe = start_router([port_a, port_b])
+        qps_2, ok_2, err_2, _ = run_load(r2_port, duration, FLEET_CLIENTS)
+        out["qps_2"] = qps_2
+        out["requests_2"] = ok_2
+        out["load_errors"] += err_2
+        out["scaling_x"] = qps_2 / max(qps_1, 1e-6)
+
+        # -- fleet-wide hot-swap under session load: zero drops ------------
+        swap_holder = {}
+
+        def do_swap():
+            admin = ServingClient("127.0.0.1", r2_port)
+            try:
+                time.sleep(min(1.0, duration / 4))
+                swap_holder["reply"] = admin.swap(2, params=p2, timeout=600)
+            finally:
+                admin.close()
+
+        # armed by run_load the moment every generator's window opens —
+        # started any earlier, the flip could land before the first
+        # pre-swap reply and the {1, 2} observation would be vacuous
+        swap_thread = _threading.Thread(target=do_swap, daemon=True)
+        _qps, ok_s, err_s, models = run_load(
+            r2_port, max(duration / 2, 2.0) + 2.0, FLEET_CLIENTS,
+            collect_models=True, on_go=swap_thread.start,
+        )
+        swap_thread.join(600)
+        swap = swap_holder.get("reply") or {}
+        out["swap_warm_ms"] = swap.get("warm_ms")
+        out["swap_replicas"] = swap.get("replicas")
+        out["swap_dropped"] = err_s
+        out["swap_flip_observed"] = models == {1, 2}
+    finally:
+        for proc, parent in procs:
+            try:
+                parent.send("stop")
+            except (BrokenPipeError, OSError):
+                pass
+        for proc, _parent in procs:
+            proc.join(timeout=30)
+            if proc.is_alive():
+                proc.terminate()
+                proc.join(timeout=10)
+    return out
+
+
 # league-stage geometry: the training leg is EPOCH-bounded (the gate
 # needs whole epoch boundaries, not a wall-clock window)
 LEAGUE_EPOCHS = 3 if QUICK else 5
@@ -1923,7 +2247,7 @@ KNOWN_STAGES = (
     "tictactoe", "device-selfplay", "geese-device-selfplay", "geese-gen",
     "geese-train", "northstar", "northstar2", "northstar3", "northstar4",
     "geese-bf16", "geister", "geister-device-selfplay", "geister-devreplay",
-    "serving", "league", "transformer", "transformer_long", "flash",
+    "serving", "fleet", "league", "transformer", "transformer_long", "flash",
 )
 # stages that consume another stage's result (main() gates them on it)
 STAGE_DEPS = {
@@ -2436,7 +2760,49 @@ def main() -> None:
 
     _run_stage(result, "serving", stage_serving)
 
-    # 3f. league plane + the twin-less env compiler (ROADMAP item 4): the
+    # 3f. fleet tier over the serving plane (docs/serving.md §Fleet):
+    # router saturation with one vs two REAL replica processes (the tier
+    # must scale ~linearly, not merely proxy), fleet-wide hot-swap under
+    # session load with a zero-drop bar, and the server-resident session
+    # leg's wire savings at bit-identical outputs
+    def stage_fleet():
+        fl = _fleet_bench(T_TRAIN)
+        result["extra"]["fleet_qps_1"] = _sig(fl["qps_1"])
+        result["extra"]["fleet_qps_2"] = _sig(fl["qps_2"])
+        result["extra"]["fleet_scaling_x"] = round(fl["scaling_x"], 3)
+        result["extra"]["fleet_cores"] = fl["cores"]
+        result["extra"]["fleet_requests"] = fl["requests_1"] + fl["requests_2"]
+        result["extra"]["fleet_clients"] = fl["clients"]
+        result["extra"]["fleet_sessions"] = fl["clients"] * fl["sessions"]
+        if fl["swap_warm_ms"] is not None:
+            result["extra"]["fleet_swap_warm_ms"] = _sig(fl["swap_warm_ms"])
+        result["extra"]["fleet_swap_replicas"] = fl["swap_replicas"]
+        result["extra"]["fleet_swap_dropped"] = fl["swap_dropped"]
+        result["extra"]["fleet_swap_flip_observed"] = fl["swap_flip_observed"]
+        result["extra"]["fleet_session_wire_ratio"] = round(
+            fl["session_wire_ratio"], 2
+        )
+        result["extra"]["fleet_session_bitident"] = fl["session_bitident"]
+        result["extra"]["fleet_session_bytes_per_req"] = fl[
+            "session_bytes_per_req"
+        ]
+        result["extra"]["fleet_ship_bytes_per_req"] = fl["ship_bytes_per_req"]
+        if fl["load_errors"]:
+            result["error"] = (result["error"] or "") + (
+                f" fleet: {fl['load_errors']} request failures under load"
+            )
+        if fl["swap_dropped"]:
+            result["error"] = (result["error"] or "") + (
+                f" fleet: hot-swap dropped {fl['swap_dropped']} requests"
+            )
+        if not fl["session_bitident"]:
+            result["error"] = (result["error"] or "") + (
+                " fleet: session outputs diverged from ship-state"
+            )
+
+    _run_stage(result, "fleet", stage_fleet)
+
+    # 3g. league plane + the twin-less env compiler (ROADMAP item 4): the
     # autovec-vs-hand-twin per-chip frac at the >= 0.5 bar, lifted
     # ConnectFour with NO hand twin, and a small end-to-end league run's
     # payoff coverage / Elo spread / promotions

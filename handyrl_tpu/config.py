@@ -226,6 +226,48 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
         # seconds between serve_* health records appended to metrics_path
         # by the standalone server (0 = off)
         "stats_interval": 30.0,
+        # server-resident recurrent sessions (docs/serving.md §Fleet tier):
+        # device-resident hidden states pinned per open session before the
+        # LRU spills to host (0 disables the session cache entirely —
+        # open_session frames become bad_request, ship-state still works)
+        "session_capacity": 1024,
+        # host-side spill ring beyond session_capacity: evicted sessions
+        # park here as numpy and re-upload on next touch (counted as
+        # session_restored); beyond this the oldest spill is dropped and
+        # its next touch is an affinity miss (fresh initial state)
+        "session_spill": 4096,
+    },
+    # --- fleet serving tier (docs/serving.md §Fleet tier) ----------------
+    # `main.py --fleet`: a front-end entry port proxying rid-pipelined
+    # client frames across N `--serve` (or `--edge`) replicas — balance by
+    # polled shed-rate/queue-depth, session affinity to the replica holding
+    # the hidden state, loud replica_lost failover + backoff rejoin, and
+    # replica-by-replica fleet-wide hot-swap
+    "fleet": {
+        # TCP entry port the router listens on (0 = ephemeral, for tests)
+        "port": 9996,
+        # backend replicas: "host:port" strings or {host, port, tags}
+        # dicts; tag "edge" marks feed-forward-only artifact capacity
+        # (skipped by stateful routes and swap propagation)
+        "replicas": [],
+        # seconds between stats-frame polls feeding the load scores
+        "stats_poll_s": 2.0,
+        # per-replica stall deadline: a replica silent this long with
+        # proxied requests pending is declared lost (bounded failover);
+        # 0 disables (failover then only on connection loss)
+        "replica_stall_s": 30.0,
+        # lost-replica rejoin backoff: starts at rejoin_backoff_s, doubles
+        # to rejoin_backoff_max_s, retries forever (PR 2 discipline)
+        "rejoin_backoff_s": 1.0,
+        "rejoin_backoff_max_s": 30.0,
+        # seconds between fleet_* health records appended to metrics_path
+        # (0 = off)
+        "stats_interval": 30.0,
+        # CPU edge replica (`main.py --edge`): port, request threads, and
+        # the frozen artifact it serves (CLI path argument overrides)
+        "edge_port": 9995,
+        "edge_workers": 2,
+        "edge_model": "",
     },
     # --- league training plane (docs/league.md) -------------------------
     # `main.py --league` (handyrl_tpu/league): population-based training —
@@ -647,6 +689,64 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
             f"train_args.serving.port={serving['port']!r} must be a TCP port "
             "(0 = ephemeral)"
         )
+    for key in ("session_capacity", "session_spill"):
+        if int(serving[key]) < 0:
+            raise ValueError(
+                f"train_args.serving.{key} must be >= 0 "
+                "(session_capacity 0 disables the session cache)"
+            )
+    fleet = train["fleet"]
+    for key in ("port", "edge_port"):
+        if not isinstance(fleet[key], int) or not 0 <= fleet[key] <= 65535:
+            raise ValueError(
+                f"train_args.fleet.{key}={fleet[key]!r} must be a TCP port "
+                "(0 = ephemeral)"
+            )
+    if not isinstance(fleet["replicas"], (list, tuple)):
+        raise ValueError(
+            "train_args.fleet.replicas must be a list of 'host:port' strings "
+            "or {host, port, tags} dicts"
+        )
+    for entry in fleet["replicas"]:
+        if isinstance(entry, str):
+            host, sep, port = entry.rpartition(":")
+            if not sep or not port.isdigit():
+                raise ValueError(
+                    f"train_args.fleet.replicas entry {entry!r} is not "
+                    "'host:port'"
+                )
+        elif isinstance(entry, dict):
+            if "host" not in entry or "port" not in entry:
+                raise ValueError(
+                    f"train_args.fleet.replicas entry {entry!r} needs "
+                    "'host' and 'port' keys"
+                )
+        else:
+            raise ValueError(
+                f"train_args.fleet.replicas entry {entry!r} must be a "
+                "'host:port' string or a dict"
+            )
+    if float(fleet["stats_poll_s"]) <= 0:
+        raise ValueError(
+            "train_args.fleet.stats_poll_s must be > 0 (it feeds the load "
+            "scores the router balances by)"
+        )
+    if float(fleet["replica_stall_s"]) < 0:
+        raise ValueError(
+            "train_args.fleet.replica_stall_s must be >= 0 (0 disables the "
+            "stall deadline; failover then only on connection loss)"
+        )
+    if float(fleet["rejoin_backoff_s"]) <= 0:
+        raise ValueError("train_args.fleet.rejoin_backoff_s must be > 0")
+    if float(fleet["rejoin_backoff_max_s"]) < float(fleet["rejoin_backoff_s"]):
+        raise ValueError(
+            "train_args.fleet.rejoin_backoff_max_s must be >= "
+            "rejoin_backoff_s (it is the backoff's cap)"
+        )
+    if float(fleet["stats_interval"]) < 0:
+        raise ValueError("train_args.fleet.stats_interval must be >= 0 (0 = off)")
+    if int(fleet["edge_workers"]) < 1:
+        raise ValueError("train_args.fleet.edge_workers must be >= 1")
     league = train["league"]
     if league["pfsp_weighting"] not in ("var", "hard", "even"):
         raise ValueError(

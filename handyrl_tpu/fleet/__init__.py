@@ -1,0 +1,21 @@
+"""Fleet serving tier: session-affinity router over N serving replicas,
+the server-resident RNN session cache, and the CPU edge-replica backend.
+
+Import order matters: ``serving.server`` imports ``fleet.sessions``, and
+``router_tier``/``edge`` import from ``serving.client`` — keeping
+``sessions`` first (and everything here importing serving SUBMODULES,
+never the ``serving`` package) is what keeps the cycle open.
+"""
+
+from .sessions import SessionCache
+from .edge import EdgeReplica, edge_main
+from .router_tier import FleetRouter, ReplicaSpec, fleet_main
+
+__all__ = [
+    "EdgeReplica",
+    "FleetRouter",
+    "ReplicaSpec",
+    "SessionCache",
+    "edge_main",
+    "fleet_main",
+]

@@ -1,0 +1,198 @@
+"""CPU edge replica: the ONNX export path stood up as a serving backend.
+
+The export artifacts (models/export.py) already freeze a policy into a
+runtime-independent file; this module puts one behind the serving wire
+protocol so the fleet router (router_tier.py) can register it as cheap
+feed-forward capacity — registered with the ``edge`` capability tag, so
+stateful routes (sessions / wire hidden state) and hot-swap propagation
+never land here.  Any object with the ``inference_batch(obs, hidden)``
+artifact API serves; ``edge_main`` loads an ``OnnxModel``
+(onnxruntime CPUExecutionProvider — an optional dependency, absent from
+the base image, so the loader gates on it with a clear error).
+
+No continuous batcher on purpose: an edge artifact is a single-threaded
+CPU session and the onnxruntime/TF runtimes batch internally poorly —
+``edge_workers`` request threads each running batch-1 inference is the
+honest shape of this capacity, and the router's load scoring (queue
+depth via the stats frame) keeps it from being oversubscribed.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..runtime.connection import (
+    QueueCommunicator,
+    accept_socket_connections,
+    open_socket_connection,
+)
+from ..utils import tree_map
+from ..utils.trace import trace_event
+
+__all__ = ["EdgeReplica", "edge_main"]
+
+
+class EdgeReplica(QueueCommunicator):
+    """Wire-compatible serving backend over one frozen artifact.
+
+    Speaks the replica subset the router actually proxies: ``infer``
+    (feed-forward only — a ``sid`` or wire ``hidden`` is refused loudly,
+    the router's ``edge`` tag means they should never arrive) and
+    ``stats`` (a serve_*-shaped record so the router's load scoring
+    works unchanged).  ``swap``/``open_session`` are bad_request: an
+    edge artifact is immutable and stateless by construction.
+    """
+
+    def __init__(self, model, port: int = 9995, workers: int = 2):
+        super().__init__(recv_timeout=None, send_queue_size=1024)
+        self.model = model
+        self.port = int(port)
+        self.workers = max(1, int(workers))
+        self.bound_port: Optional[int] = None
+        self._stats_lock = threading.Lock()
+        self.requests_in = 0
+        self.replies = 0
+        self.errors: Dict[str, int] = {}
+        self._depth = 0
+        self._sock = None
+
+    def run(self) -> "EdgeReplica":
+        self._sock = open_socket_connection(self.port)
+        self.bound_port = self._sock.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        for i in range(self.workers):
+            threading.Thread(
+                target=self._serve_loop, daemon=True, name=f"edge-worker-{i}"
+            ).start()
+        return self
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        for conn in accept_socket_connections(timeout=0.5, sock=self._sock):
+            if conn is None:
+                if self.shutdown_flag:
+                    break
+                continue
+            self.add_connection(conn)
+
+    def _serve_loop(self) -> None:
+        while not self.shutdown_flag:
+            try:
+                conn, frame = self.recv(timeout=0.3)
+            except _queue.Empty:
+                continue
+            try:
+                req, data = frame
+            except (TypeError, ValueError):
+                continue
+            if req == "heartbeat" or req == "__hb__":
+                continue
+            if not isinstance(data, dict):
+                data = {}
+            rid = data.get("rid")
+            try:
+                if req == "infer":
+                    self._handle_infer(conn, rid, data)
+                elif req == "stats":
+                    self.send(conn, ("stats",
+                                     {"rid": rid, "stats": self.stats_record()}))
+                else:
+                    # swap / open_session / close_session / unknown: an
+                    # edge artifact is immutable and stateless — say so
+                    self._error(conn, rid, "bad_request",
+                                f"edge replica cannot serve {req!r} "
+                                "(frozen feed-forward artifact)")
+            except Exception as exc:
+                # worker threads are the serving capacity: no frame may
+                # kill one (same contract as ServingServer._dispatch)
+                self._error(conn, rid, "error", f"{type(exc).__name__}: {exc}")
+
+    def _handle_infer(self, conn, rid, data: Dict[str, Any]) -> None:
+        with self._stats_lock:
+            self.requests_in += 1
+            self._depth += 1
+        try:
+            if data.get("sid") is not None or data.get("hidden") is not None:
+                self._error(conn, rid, "bad_request",
+                            "edge replica is feed-forward only (no session "
+                            "cache, no recurrent state) — route stateful "
+                            "requests to a full serving replica")
+                return
+            t0 = time.monotonic()
+            obs = tree_map(lambda x: np.asarray(x)[None], data.get("obs"))
+            out = self.model.inference_batch(obs)
+            out = tree_map(lambda x: np.asarray(x)[0], out)
+            trace_event("serve.request", time.monotonic() - t0, t0=t0,
+                        plane="fleet", ok=True, edge=True)
+            with self._stats_lock:
+                self.replies += 1
+            # model 0 = "the frozen artifact": edge capacity serves one
+            # immutable version, there is no router generation to report
+            self.send(conn, ("result", {"rid": rid, "model": 0, "out": out}))
+        finally:
+            with self._stats_lock:
+                self._depth -= 1
+
+    def _error(self, conn, rid, kind: str, msg: str) -> None:
+        with self._stats_lock:
+            self.errors[kind] = self.errors.get(kind, 0) + 1
+        self.send(conn, ("error", {"rid": rid, "kind": kind, "msg": msg}))
+
+    def stats_record(self) -> Dict[str, Any]:
+        """serve_*-shaped so FleetRouter._Replica.score_from reads edge
+        and full replicas identically; keys are the METRIC_KEYS subset an
+        artifact backend can honestly report (no batcher, no swaps)."""
+        with self._stats_lock:
+            return {
+                "serve_requests": self.requests_in,
+                "serve_replies": self.replies,
+                "serve_depth": self._depth,
+                "serve_shed": 0,
+                "serve_errors": sum(self.errors.values()),
+                "serve_connections": self.connection_count(),
+            }
+
+
+def edge_main(args: Dict[str, Any]) -> None:
+    """``main.py --edge <artifact.onnx>``: serve a frozen export artifact
+    as fleet edge capacity (register it in ``fleet.replicas`` with the
+    ``edge`` tag)."""
+    train = args["train_args"]
+    fleet_cfg = train.get("fleet", {})
+    path = args.get("edge_model") or fleet_cfg.get("edge_model")
+    if not path:
+        raise ValueError(
+            "no edge artifact: pass it on the command line "
+            "(main.py --edge model.onnx) or set fleet.edge_model"
+        )
+    from ..models.export import ExportedModel, OnnxModel
+
+    # .onnx needs the optional onnxruntime; the jax.export artifact
+    # (.jaxm) runs on the baked-in toolchain — both serve identically
+    model = OnnxModel(path) if str(path).endswith(".onnx") else ExportedModel(path)
+    replica = EdgeReplica(
+        model,
+        port=int(fleet_cfg.get("edge_port", 9995)),
+        workers=int(fleet_cfg.get("edge_workers", 2)),
+    ).run()
+    print(f"edge: serving {path} on port {replica.bound_port} "
+          f"({replica.workers} workers)")
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        print("edge: shutting down")
+    finally:
+        replica.shutdown()

@@ -1,0 +1,578 @@
+"""Fleet front-end: session-affinity routing over N serving replicas.
+
+The horizontal tier ROADMAP item 2 names (docs/serving.md §Fleet tier):
+one entry port accepts thousands of ``ServingClient`` connections and
+proxies their rid-pipelined frames to backend ``ServingServer`` replicas
+— the plane-split discipline (front-end vs compute) applied to
+inference.  Composition of machinery already banked, nothing novel on
+the wire:
+
+* transport: the framed-socket hub (``QueueCommunicator``) on the client
+  side, one pipelined ``ServingClient`` per backend replica — the proxy
+  speaks the replica protocol as an ordinary client, so replicas need no
+  fleet awareness;
+* balancing: new sessions and stateless requests land on the live
+  replica with the lowest load score — queue depth + shed rate from the
+  existing ``stats`` frame, polled on ``stats_poll_s``;
+* affinity: an ``infer`` carrying a ``sid`` follows the session to the
+  replica that owns its hidden state (fleet/sessions.py).  When that
+  replica dies the session is re-pointed to a survivor, which serves it
+  fresh-state and counts the affinity miss — degraded loudly, never a
+  hang;
+* failure: a replica that drops its connection (or goes silent past the
+  client stall deadline) fails every in-flight proxied request with a
+  loud ``replica_lost`` error kind, is reaped from rotation, and is
+  re-joined with exponential backoff (the PR 2 rejoin discipline);
+* fleet-wide hot-swap: one ``swap`` frame at the front propagates
+  replica-by-replica — each replica runs its own zero-drop
+  warm-then-flip while the others keep serving, so the tier as a whole
+  drops nothing;
+* capabilities: replicas registered with the ``edge`` tag (the ONNX CPU
+  backend, fleet/edge.py) receive only feed-forward traffic — stateful
+  routes (sessions / wire hidden) and swap propagation skip them.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from ..runtime.connection import (
+    FramedConnection,
+    QueueCommunicator,
+    accept_socket_connections,
+    open_socket_connection,
+)
+from ..serving.client import ServingClient, ServingError
+from ..utils.metrics import append_metrics_record
+from ..utils.trace import trace_event
+
+__all__ = ["FleetRouter", "ReplicaSpec", "fleet_main"]
+
+# stats-frame shed rate is weighted against raw queue depth when scoring
+# replicas: one shed in the last window outweighs ~100 queued requests,
+# because shedding proves the replica is ALREADY past its SLO capacity
+_SHED_WEIGHT = 100.0
+
+
+class ReplicaSpec:
+    """One backend's address + capability tags (config-registered)."""
+
+    __slots__ = ("host", "port", "tags", "name")
+
+    def __init__(self, host: str, port: int, tags=()):
+        self.host = str(host)
+        self.port = int(port)
+        self.tags = frozenset(str(t) for t in tags)
+        self.name = f"{self.host}:{self.port}"
+
+    @classmethod
+    def parse(cls, entry) -> "ReplicaSpec":
+        """'host:port' strings or {'host', 'port', 'tags'?} dicts — the
+        two spellings ``fleet.replicas`` accepts (config.py validates)."""
+        if isinstance(entry, cls):
+            return entry
+        if isinstance(entry, str):
+            host, _, port = entry.rpartition(":")
+            return cls(host or "127.0.0.1", int(port))
+        return cls(entry["host"], entry["port"], entry.get("tags", ()))
+
+
+class _Replica:
+    """Live state for one backend: its proxy client, liveness, and the
+    last-polled load score."""
+
+    def __init__(self, spec: ReplicaSpec):
+        self.spec = spec
+        self.client: Optional[ServingClient] = None
+        self.alive = False
+        self.load = 0.0
+        self.picked = 0  # tie-break: spread equal-load picks round-robin
+        self._last_stats: Dict[str, Any] = {}
+        self.lock = threading.Lock()
+
+    @property
+    def is_edge(self) -> bool:
+        return "edge" in self.spec.tags
+
+    def score_from(self, stats: Dict[str, Any]) -> float:
+        """Load score from a stats-frame record: instantaneous queue depth
+        plus the shed rate over the window since the previous poll."""
+        prev = self._last_stats
+        self._last_stats = stats
+        depth = float(stats.get("serve_depth") or 0.0)
+        shed = float(stats.get("serve_shed") or 0.0)
+        requests = float(stats.get("serve_requests") or 0.0)
+        d_shed = max(0.0, shed - float(prev.get("serve_shed") or 0.0))
+        d_req = max(1.0, requests - float(prev.get("serve_requests") or 0.0))
+        return depth + _SHED_WEIGHT * (d_shed / d_req)
+
+
+class FleetRouter(QueueCommunicator):
+    """Entry-port front-end proxying infer/stats/swap/session frames to a
+    fleet of serving replicas."""
+
+    def __init__(
+        self,
+        fleet_cfg: Dict[str, Any],
+        metrics_path: Optional[str] = None,
+    ):
+        cfg = dict(fleet_cfg or {})
+        super().__init__(
+            recv_timeout=None,
+            # same reasoning as ServingServer: reply bursts to a pipelining
+            # client are the product, not a fault signal
+            send_queue_size=1024,
+        )
+        self.port = int(cfg.get("port", 9996))
+        self.bound_port: Optional[int] = None
+        self.stats_poll_s = float(cfg.get("stats_poll_s", 2.0))
+        self.replica_stall_s = float(cfg.get("replica_stall_s", 30.0))
+        self.backoff_s = float(cfg.get("rejoin_backoff_s", 1.0))
+        self.backoff_max_s = float(cfg.get("rejoin_backoff_max_s", 30.0))
+        self.stats_interval = float(cfg.get("stats_interval", 30.0))
+        self._metrics_path = metrics_path
+        self.replicas: List[_Replica] = [
+            _Replica(ReplicaSpec.parse(e)) for e in cfg.get("replicas", ())
+        ]
+        if not self.replicas:
+            raise ValueError("fleet.replicas is empty — nothing to route to")
+        # sid -> replica owning its hidden state.  Entries re-point to a
+        # survivor when the owner dies (the new owner then counts an
+        # affinity miss and serves the session fresh-state)
+        self._affinity: Dict[str, _Replica] = {}
+        self._affinity_lock = threading.Lock()
+        # blocking control ops (session open/close, swap propagation,
+        # stats fan-out) run here, never on the dispatch thread
+        self._ctl_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="fleet-ctl"
+        )
+        self._rejoining: set = set()
+        self._stats_lock = threading.Lock()
+        self.requests_in = 0
+        self.replies = 0
+        self.errors: Dict[str, int] = {}
+        self.sessions_routed = 0
+        self.replicas_lost = 0
+        self.hot_swaps = 0
+        self._stats_t0 = time.monotonic()
+        self._stats_served0 = 0
+        self._sock = None
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self, connect_timeout: float = 30.0) -> "FleetRouter":
+        """Connect the replica fleet (each with retry — replicas may still
+        be booting), then bind the entry port and start serving."""
+        for rep in self.replicas:
+            try:
+                self._connect(rep, retry_seconds=connect_timeout)
+            except OSError as exc:
+                # a replica down at boot is the same as one lost later:
+                # route around it and let the rejoin loop chase it
+                print(f"fleet: replica {rep.spec.name} unreachable at start "
+                      f"({exc}); rejoining in background")
+                self._mark_lost(rep)
+        if not any(r.alive for r in self.replicas):
+            raise ConnectionError("fleet: no replica reachable at startup")
+        self._sock = open_socket_connection(self.port)
+        self._sock.listen(1024)
+        self.bound_port = self._sock.getsockname()[1]
+        targets = [self._accept_loop, self._dispatch, self._poll_loop]
+        if self._metrics_path and self.stats_interval > 0:
+            targets.append(self._metrics_loop)
+        for target in targets:
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._ctl_pool.shutdown(wait=False)
+        for rep in self.replicas:
+            with rep.lock:
+                client, rep.client, rep.alive = rep.client, None, False
+            if client is not None:
+                client.close()
+
+    def _accept_loop(self) -> None:
+        for conn in accept_socket_connections(timeout=0.5, sock=self._sock):
+            if conn is None:
+                if self.shutdown_flag:
+                    break
+                continue
+            self.add_connection(conn)
+
+    # -- replica fleet ------------------------------------------------------
+
+    def _connect(self, rep: _Replica, retry_seconds: float = 0.0) -> None:
+        client = ServingClient(
+            rep.spec.host, rep.spec.port,
+            retry_seconds=retry_seconds,
+            # the stall deadline turns a silent replica into a named
+            # failure on every pending proxied request — bounded failover
+            stall_timeout=self.replica_stall_s or None,
+        )
+        with rep.lock:
+            rep.client = client
+            rep.alive = True
+            rep.load = 0.0
+
+    def _mark_lost(self, rep: _Replica) -> None:
+        """Reap a dead replica: fail-fast state, count the loss, schedule
+        the backoff rejoin.  Idempotent under racing reporters (the poll
+        loop, several reply callbacks)."""
+        with rep.lock:
+            was_alive, rep.alive = rep.alive, False
+            client, rep.client = rep.client, None
+        if client is not None:
+            client.close()
+        if was_alive:
+            with self._stats_lock:
+                self.replicas_lost += 1
+            print(f"fleet: replica {rep.spec.name} lost; "
+                  f"re-routing its sessions, rejoining with backoff")
+        with self._stats_lock:
+            if rep in self._rejoining:
+                return
+            self._rejoining.add(rep)
+        threading.Thread(
+            target=self._rejoin_loop, args=(rep,), daemon=True,
+            name=f"fleet-rejoin-{rep.spec.name}",
+        ).start()
+
+    def _rejoin_loop(self, rep: _Replica) -> None:
+        """PR 2 discipline: exponential backoff, capped, forever — a
+        replica that restarts rejoins the rotation without operator help."""
+        backoff = self.backoff_s
+        try:
+            while not self.shutdown_flag:
+                time.sleep(backoff)
+                if self.shutdown_flag:
+                    return
+                try:
+                    self._connect(rep)
+                    print(f"fleet: replica {rep.spec.name} rejoined")
+                    return
+                except OSError:
+                    backoff = min(backoff * 2.0, self.backoff_max_s)
+        finally:
+            with self._stats_lock:
+                self._rejoining.discard(rep)
+
+    def _live(self, stateful: bool) -> List[_Replica]:
+        return [
+            r for r in self.replicas
+            if r.alive and not (stateful and r.is_edge)
+        ]
+
+    def _pick(self, stateful: bool) -> Optional[_Replica]:
+        """Lowest-load live replica (capability-filtered); None when the
+        whole (eligible) fleet is down."""
+        t0 = time.monotonic()
+        candidates = self._live(stateful)
+        if not candidates:
+            return None
+        rep = min(candidates, key=lambda r: (r.load, r.picked))
+        rep.picked += 1
+        trace_event("fleet.route", time.monotonic() - t0, t0=t0,
+                    plane="fleet", replicas=len(candidates))
+        return rep
+
+    def _poll_loop(self) -> None:
+        """The balancing signal: shed-rate/queue-depth via the existing
+        stats frame, each replica polled on its own pool task so one
+        stalled replica never delays the others' scores."""
+        while not self.shutdown_flag:
+            time.sleep(self.stats_poll_s)
+            if self.shutdown_flag:
+                return
+            for rep in self.replicas:
+                if rep.alive:
+                    self._ctl_pool.submit(self._poll_one, rep)
+
+    def _poll_one(self, rep: _Replica) -> None:
+        client = rep.client
+        if client is None:
+            return
+        try:
+            stats = client.stats(timeout=max(self.stats_poll_s * 4, 10.0))
+        except Exception:
+            self._mark_lost(rep)
+            return
+        rep.load = rep.score_from(stats or {})
+
+    # -- request dispatch ----------------------------------------------------
+
+    def _dispatch(self) -> None:
+        while not self.shutdown_flag:
+            try:
+                conn, frame = self.recv(timeout=0.3)
+            except _queue.Empty:
+                continue
+            try:
+                req, data = frame
+            except (TypeError, ValueError):
+                continue
+            if req == "heartbeat" or req == "__hb__":
+                continue
+            if not isinstance(data, dict):
+                data = {}
+            rid = data.get("rid")
+            try:
+                if req == "infer":
+                    self._handle_infer(conn, data)
+                elif req == "open_session":
+                    self._ctl_pool.submit(self._handle_open_session, conn, data)
+                elif req == "close_session":
+                    self._ctl_pool.submit(self._handle_close_session, conn, data)
+                elif req == "stats":
+                    self._ctl_pool.submit(self._handle_stats, conn, rid)
+                elif req == "swap":
+                    self._ctl_pool.submit(self._handle_swap, conn, data)
+                else:
+                    self._error(conn, rid, "bad_request",
+                                f"unknown request {req!r}")
+            except Exception as exc:
+                # THE dispatch thread: no frame may kill it (see
+                # ServingServer._dispatch — same contract)
+                self._error(conn, rid, "error", f"{type(exc).__name__}: {exc}")
+
+    def _handle_infer(self, conn: FramedConnection, data: Dict[str, Any]) -> None:
+        with self._stats_lock:
+            self.requests_in += 1
+        arrival = time.monotonic()
+        rid = data.get("rid")
+        sid = data.get("sid")
+        stateful = sid is not None or data.get("hidden") is not None
+        rep = None
+        if sid is not None:
+            with self._affinity_lock:
+                rep = self._affinity.get(sid)
+            if rep is not None and not rep.alive:
+                rep = None  # owner died: re-route below
+        if rep is None:
+            rep = self._pick(stateful)
+            if rep is None:
+                self._error(conn, rid, "no_replica",
+                            "no live replica can serve this request "
+                            f"(stateful={stateful})")
+                return
+            if sid is not None:
+                # session re-pointed (first infer, or owner lost): the new
+                # owner serves fresh-state and counts the affinity miss
+                with self._affinity_lock:
+                    self._affinity[sid] = rep
+        client = rep.client
+        if client is None:
+            self._error(conn, rid, "replica_lost",
+                        f"replica {rep.spec.name} lost before proxy")
+            return
+        fut = client.submit(
+            data.get("obs"), data.get("model", -1), data.get("hidden"),
+            data.get("slo_ms"), sid=sid,
+        )
+        fut.add_done_callback(
+            lambda f, c=conn, r=rid, p=rep, a=arrival: self._relay(c, r, p, f, a)
+        )
+
+    def _relay(self, conn: FramedConnection, rid, rep: _Replica, fut: Future,
+               arrival: float) -> None:
+        """Reply callback for a proxied infer: forward the result/error to
+        the fronted client under ITS rid; a transport-level failure means
+        the replica itself is gone — loud replica_lost, never a hang."""
+        exc = fut.exception()
+        trace_event("fleet.proxy", time.monotonic() - arrival, t0=arrival,
+                    plane="fleet", ok=exc is None, replica=rep.spec.name)
+        if exc is None:
+            d = fut.result()
+            reply = {"rid": rid, "model": d.get("model"), "out": d.get("out")}
+            if "sid" in d:
+                reply["sid"] = d["sid"]
+            with self._stats_lock:
+                self.replies += 1
+            self.send(conn, ("result", reply))
+            return
+        if isinstance(exc, ServingError) and exc.kind != "stalled":
+            # a request-level failure (shed/deadline/bad_request/...) is
+            # the replica WORKING as designed: forward it verbatim
+            self._error(conn, rid, exc.kind, str(exc))
+            return
+        # connection loss or stall deadline: the replica is gone
+        self._mark_lost(rep)
+        self._error(conn, rid, "replica_lost",
+                    f"replica {rep.spec.name} lost mid-request "
+                    f"({type(exc).__name__}: {exc})")
+
+    # -- control frames (pool) ----------------------------------------------
+
+    def _handle_open_session(self, conn: FramedConnection, data: Dict[str, Any]) -> None:
+        rid = data.get("rid")
+        try:
+            rep = self._pick(stateful=True)
+            if rep is None or rep.client is None:
+                self._error(conn, rid, "no_replica",
+                            "no live stateful replica to host the session")
+                return
+            sid = rep.client.open_session(model=data.get("model", -1))
+            with self._affinity_lock:
+                self._affinity[sid] = rep
+            with self._stats_lock:
+                self.sessions_routed += 1
+            self.send(conn, ("session", {"rid": rid, "sid": sid}))
+        except Exception as exc:
+            self._error(conn, rid, "replica_lost",
+                        f"open_session failed: {type(exc).__name__}: {exc}")
+
+    def _handle_close_session(self, conn: FramedConnection, data: Dict[str, Any]) -> None:
+        rid = data.get("rid")
+        sid = data.get("sid")
+        with self._affinity_lock:
+            rep = self._affinity.pop(sid, None)
+        existed = False
+        try:
+            if rep is not None and rep.alive and rep.client is not None:
+                existed = bool(
+                    rep.client.close_session(sid).get("existed", False)
+                )
+        except Exception:
+            pass  # owner died with the session: it is closed by definition
+        self.send(conn, ("session_closed",
+                         {"rid": rid, "sid": sid, "existed": existed}))
+
+    def _handle_stats(self, conn: FramedConnection, rid) -> None:
+        try:
+            per_replica = {}
+            for rep in self.replicas:
+                client = rep.client
+                if rep.alive and client is not None:
+                    try:
+                        per_replica[rep.spec.name] = client.stats(timeout=10.0)
+                    except Exception:
+                        self._mark_lost(rep)
+            stats = dict(self.stats_record(), replicas=per_replica)
+            self.send(conn, ("stats", {"rid": rid, "stats": stats}))
+        except Exception as exc:
+            self._error(conn, rid, "error", f"{type(exc).__name__}: {exc}")
+
+    def _handle_swap(self, conn: FramedConnection, data: Dict[str, Any]) -> None:
+        """Fleet-wide hot-swap: warm-then-flip propagated replica-by-
+        replica.  Sequential on purpose — each replica's standby engine
+        warms and flips with zero drops while every OTHER replica keeps
+        serving at full capacity; a parallel fan-out would have the whole
+        fleet paying warm-up compile pressure at once."""
+        rid = data.get("rid")
+        sid = data.get("id")
+        warm_ms_total = 0.0
+        flipped = 0
+        try:
+            for rep in self.replicas:
+                if rep.is_edge or not rep.alive:
+                    continue  # edge artifacts don't take jax params
+                client = rep.client
+                if client is None:
+                    continue
+                reply = client.swap(sid, data.get("params"))
+                warm_ms_total += float(reply.get("warm_ms") or 0.0)
+                flipped += 1
+            if flipped == 0:
+                self._error(conn, rid, "swap_failed",
+                            "no live swap-capable replica")
+                return
+            with self._stats_lock:
+                self.hot_swaps += 1
+            self.send(conn, ("swapped", {
+                "rid": rid, "id": sid, "warm_ms": warm_ms_total,
+                "replicas": flipped,
+            }))
+        except Exception as exc:
+            # a mixed-version fleet is an operator problem: loud, with the
+            # partial progress in the message
+            self._error(conn, rid, "swap_failed",
+                        f"{flipped} replica(s) flipped, then "
+                        f"{type(exc).__name__}: {exc}")
+
+    def _error(self, conn: FramedConnection, rid, kind: str, msg: str) -> None:
+        with self._stats_lock:
+            self.errors[kind] = self.errors.get(kind, 0) + 1
+        self.send(conn, ("error", {"rid": rid, "kind": kind, "msg": msg}))
+
+    # -- stats / metrics -----------------------------------------------------
+
+    def stats_record(self, advance_window: bool = False) -> Dict[str, Any]:
+        """One metrics.jsonl-shaped record of the fleet front-end's health;
+        every key registered in utils.metrics.METRIC_KEYS (MET006)."""
+        now = time.monotonic()
+        with self._stats_lock:
+            requests_in = self.requests_in
+            replies = self.replies
+            errors = sum(self.errors.values())
+            sessions = self.sessions_routed
+            lost = self.replicas_lost
+            swaps = self.hot_swaps
+            dt = max(now - self._stats_t0, 1e-6)
+            served_delta = replies - self._stats_served0
+            if advance_window:
+                self._stats_t0 = now
+                self._stats_served0 = replies
+        record: Dict[str, Any] = {
+            "fleet_requests": requests_in,
+            "fleet_replies": replies,
+            "fleet_errors": errors,
+            "fleet_qps": round(served_delta / dt, 2),
+            "fleet_replicas": len(self.replicas),
+            "fleet_replicas_live": sum(1 for r in self.replicas if r.alive),
+            "fleet_replica_lost": lost,
+            "fleet_sessions": sessions,
+            "fleet_hot_swaps": swaps,
+        }
+        return record
+
+    def _metrics_loop(self) -> None:
+        while not self.shutdown_flag:
+            time.sleep(self.stats_interval)
+            if self.shutdown_flag:
+                return
+            try:
+                append_metrics_record(
+                    self._metrics_path, self.stats_record(advance_window=True)
+                )
+            except Exception as exc:
+                print(f"fleet: metrics write failed: {type(exc).__name__}: {exc}")
+
+
+def fleet_main(args: Dict[str, Any]) -> None:
+    """``main.py --fleet``: the front-end tier over a configured replica
+    fleet (``fleet.replicas`` — start each backend with ``--serve`` or
+    ``--edge`` first)."""
+    from ..utils import trace
+
+    train = args["train_args"]
+    fleet_cfg = train.get("fleet", {})
+    if trace.configure(train.get("trace")):
+        print(f"fleet: trace spans -> {trace.current_path()}")
+    router = FleetRouter(
+        fleet_cfg, metrics_path=train.get("metrics_path")
+    ).run()
+    specs = ", ".join(
+        r.spec.name + ("[edge]" if r.is_edge else "")
+        for r in router.replicas
+    )
+    print(f"fleet: entry port {router.bound_port} over replicas {specs}")
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        print("fleet: shutting down")
+    finally:
+        router.shutdown()

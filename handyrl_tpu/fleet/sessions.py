@@ -1,0 +1,191 @@
+"""Server-resident RNN session cache (docs/serving.md §Fleet tier).
+
+PR 10's serving plane ships recurrent hidden state both ways on every
+request (client keeps it, wire carries it) — for a DRC-sized state that
+is ~25x the observation bytes.  A *session* pins that state next to the
+model instead: ``open_session`` mints a session id, every ``infer``
+carrying that sid reads its hidden from this cache and writes the next
+step's state back, and the wire carries only the observation and the
+policy/value outputs.
+
+Residency discipline:
+
+* resident entries live device-side (``jax.device_put`` onto the serving
+  engine's device) so the next batch stacks them without a fresh host
+  upload;
+* over ``capacity`` the least-recently-used session is EVICTED to a
+  host-side spill ring (bounded, ``spill_capacity``): device memory is
+  the scarce resource, host RAM is the cheap second tier;
+* a spilled session's next infer re-uploads it (counted
+  ``session_restored``, traced as ``session.restore``) — bit-identical,
+  pinned by the fleet tests;
+* a session absent from BOTH tiers (spill overflow, or a request routed
+  to a replica that never saw the sid — the front-end re-routes sessions
+  off a dead replica) is an *affinity miss*: the cache re-adopts the sid
+  with fresh initial state so the client keeps playing, and counts it.
+
+The cache is transport-free and device-optional (``device=None`` keeps
+everything host-side — the CPU edge replica's mode), so its semantics
+pin socket-free in tests/test_fleet.py.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils import tree_map
+from ..utils.trace import trace_event
+
+__all__ = ["SessionCache"]
+
+
+class SessionCache:
+    """LRU session store: device-resident hidden state keyed by session id,
+    with a bounded host-side spill ring as the second tier."""
+
+    def __init__(self, capacity: int = 1024, spill_capacity: int = 4096,
+                 device=None):
+        self.capacity = max(1, int(capacity))
+        self.spill_capacity = max(0, int(spill_capacity))
+        # the pin target; the serving server adopts the engine's device on
+        # first use (the router owns engine placement, not this cache)
+        self.device = device
+        # sid -> hidden pytree (device arrays when a device is set)
+        self._resident: "OrderedDict[str, Any]" = OrderedDict()
+        # sid -> host numpy pytree (evicted, awaiting restore or overflow)
+        self._spill: "OrderedDict[str, Any]" = OrderedDict()
+        # opened but not yet stored: their first lookup is a FRESH start,
+        # not an affinity miss — the miss counter must mean "state lost",
+        # or re-route diagnostics drown in session-open noise
+        self._fresh: set = set()
+        self._lock = threading.Lock()
+        # sids are opaque strings unique ACROSS replicas: the front-end
+        # keys its affinity map by sid alone, so two replicas minting
+        # colliding ids would cross their sessions' routing
+        self._prefix = os.urandom(4).hex()
+        self._next = 0
+        self.opened = 0
+        self.closed = 0
+        self.evictions = 0
+        self.restored = 0
+        self.affinity_misses = 0
+        self.spill_drops = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def open(self) -> str:
+        """Mint a session id.  No capacity is consumed until the first
+        ``store`` — an opened-but-never-inferred session costs nothing."""
+        with self._lock:
+            self._next += 1
+            self.opened += 1
+            sid = f"s{self._prefix}-{self._next}"
+            self._fresh.add(sid)
+            return sid
+
+    def close(self, sid: str) -> bool:
+        """Release the session's slot (both tiers); True if it existed."""
+        with self._lock:
+            was_fresh = sid in self._fresh
+            self._fresh.discard(sid)
+            hit = bool(
+                (self._resident.pop(sid, None) is not None)
+                | (self._spill.pop(sid, None) is not None)
+            ) or was_fresh
+            # only real closes count: a double-close (or a stale sid) is a
+            # no-op, and the counter must stay opened-minus-live honest
+            self.closed += 1 if hit else 0
+            return hit
+
+    # -- the infer seams -----------------------------------------------------
+
+    def lookup(self, sid: str) -> Tuple[Optional[Any], str]:
+        """Fetch the session's hidden state for the next infer.
+
+        Returns ``(hidden, status)`` with status one of ``resident`` /
+        ``restored`` / ``fresh`` / ``miss``.  ``fresh`` (opened here, not
+        yet stored) and ``miss`` (state lost: spill overflow or a session
+        re-routed from a dead replica) both return ``hidden=None`` — the
+        engine then uses the model's initial state — but only a miss is
+        counted; the following ``store`` (re-)adopts the sid either way.
+        """
+        with self._lock:
+            hidden = self._resident.get(sid)
+            if hidden is not None:
+                self._resident.move_to_end(sid)
+                return hidden, "resident"
+            spilled = self._spill.pop(sid, None)
+            if spilled is None and sid in self._fresh:
+                return None, "fresh"
+        if spilled is None:
+            with self._lock:
+                self.affinity_misses += 1
+            return None, "miss"
+        t0 = time.monotonic()
+        hidden = self._pin(spilled)
+        trace_event("session.restore", time.monotonic() - t0, t0=t0,
+                    plane="fleet")
+        with self._lock:
+            self.restored += 1
+            self._resident[sid] = hidden
+            self._resident.move_to_end(sid)
+            self._evict_over_capacity()
+        return hidden, "restored"
+
+    def store(self, sid: str, hidden: Any) -> None:
+        """Write the session's next-step hidden (the engine's output tree
+        already lives host-side after the batch fetch; it is re-pinned to
+        the device here, off the engine's dispatch path)."""
+        if hidden is None:
+            return
+        pinned = self._pin(hidden)
+        with self._lock:
+            self._fresh.discard(sid)
+            self._resident[sid] = pinned
+            self._resident.move_to_end(sid)
+            self._evict_over_capacity()
+
+    def _pin(self, hidden: Any) -> Any:
+        if self.device is None:
+            return tree_map(np.asarray, hidden)
+        import jax
+
+        return jax.device_put(hidden, self.device)
+
+    def _evict_over_capacity(self) -> None:
+        """Caller holds the lock.  LRU residents spill to the host ring;
+        the ring itself drops ITS oldest beyond spill_capacity (those
+        sessions resurface as affinity misses — counted, never a hang)."""
+        while len(self._resident) > self.capacity:
+            old_sid, old_hidden = self._resident.popitem(last=False)
+            self.evictions += 1
+            if self.spill_capacity <= 0:
+                self.spill_drops += 1
+                continue
+            # host copy: np.asarray realizes device arrays — eviction is
+            # the documented spill cost, paid off the engine's hot loop
+            self._spill[old_sid] = tree_map(np.asarray, old_hidden)
+            self._spill.move_to_end(old_sid)
+            while len(self._spill) > self.spill_capacity:
+                self._spill.popitem(last=False)
+                self.spill_drops += 1
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "session_resident": len(self._resident),
+                "session_spilled": len(self._spill),
+                "session_opened": self.opened,
+                "session_closed": self.closed,
+                "session_evictions": self.evictions,
+                "session_restored": self.restored,
+                "session_affinity_miss": self.affinity_misses,
+            }

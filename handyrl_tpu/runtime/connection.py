@@ -90,6 +90,11 @@ class FramedConnection:
         self.default_timeout = timeout
         self._send_lock = threading.Lock()
         self._recv_lock = threading.Lock()
+        # frame-payload byte tallies (headers included), updated under the
+        # respective direction's lock: the fleet bench reads these to
+        # measure wire bytes/request — session routing's whole claim
+        self.bytes_sent = 0
+        self.bytes_received = 0
 
     def fileno(self) -> int:
         return self.conn.fileno()
@@ -150,6 +155,7 @@ class FramedConnection:
                 hard_deadline, gap = time.monotonic() + gap, None
             (length,) = _HEADER.unpack(self._recv_exact(4, gap, hard_deadline))
             payload = self._recv_exact(length, gap, hard_deadline) if length else b""
+            self.bytes_received += 4 + length
         return codec.loads(payload)
 
     def send(self, obj: Any, timeout=_UNSET, hard: bool = False) -> None:
@@ -177,6 +183,7 @@ class FramedConnection:
 
     def _send_bytes(self, data: bytes, gap: Optional[float], hard: bool = False) -> None:
         """Write one frame; caller holds the send lock."""
+        self.bytes_sent += len(data)
         if gap is None:
             self.conn.sendall(data)
             return

@@ -474,6 +474,13 @@ class ContinuousBatcher:
 
     # -- introspection ------------------------------------------------------
 
+    @property
+    def device(self):
+        """The engine's primary device — where its variables are committed
+        and where the session cache pins resident hidden state so the next
+        batch stacks it without a fresh host upload."""
+        return self._devices[0]
+
     def latencies_ms(self) -> List[float]:
         return self._latency.snapshot()
 

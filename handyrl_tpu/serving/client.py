@@ -6,13 +6,31 @@ can keep a submit window open (the load generator the bench uses) or use
 the blocking ``infer`` facade.  Server-side sheds and deadline misses
 surface as ``ServingError`` with the wire ``kind`` — fast-fail reaches
 the caller as an exception, never as a hang.
+
+Liveness: ``stall_timeout`` arms the framed transport's stall deadline
+on the receive side — a peer that keeps the socket open but stops
+sending bytes while requests are pending fails every pending future
+with ``ServingError(kind="stalled")`` instead of hanging them until
+their per-call timeouts.  An idle connection (nothing pending) is never
+reaped: request/reply clients are legitimately bursty.
+
+Desync visibility: a reply frame whose ``rid`` is missing or unknown
+(a confused or misbehaving server) is COUNTED (``replies_orphaned``)
+and warned about once, instead of being silently dropped.
+
+Sessions (docs/serving.md §Fleet tier): ``open_session`` pins recurrent
+hidden state server-side; ``submit(..., sid=...)`` then carries only the
+observation — the ship-hidden-state-both-ways path stays available as
+the stateless fallback.
 """
 
 from __future__ import annotations
 
+import socket
+import sys
 import threading
 from concurrent.futures import Future
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -24,7 +42,8 @@ __all__ = ["ServingClient", "ServingError"]
 
 class ServingError(RuntimeError):
     """Server-reported request failure; ``kind`` is the wire tag
-    (shed / deadline / stopped / bad_request / swap_failed / ...)."""
+    (shed / deadline / stopped / bad_request / swap_failed / stalled /
+    replica_lost / ...)."""
 
     def __init__(self, kind: str, msg: str):
         super().__init__(f"[{kind}] {msg}")
@@ -33,14 +52,20 @@ class ServingError(RuntimeError):
 
 class ServingClient:
     def __init__(self, host: str, port: int, timeout: float = 60.0,
-                 retry_seconds: float = 0.0):
+                 retry_seconds: float = 0.0,
+                 stall_timeout: Optional[float] = None):
         self.conn = connect_socket_connection(
             host, int(port), timeout=timeout, retry_seconds=retry_seconds
+        )
+        self.stall_timeout = (
+            None if not stall_timeout else float(stall_timeout)
         )
         self._lock = threading.Lock()
         self._pending: Dict[int, Future] = {}
         self._rid = 0
         self._closed = False
+        self.replies_orphaned = 0
+        self._orphan_warned = False
         self._recv_thread = threading.Thread(
             target=self._recv_loop, daemon=True, name="serve-client-recv"
         )
@@ -51,7 +76,25 @@ class ServingClient:
     def _recv_loop(self) -> None:
         while True:
             try:
-                kind, data = self.conn.recv(timeout=None)
+                kind, data = self.conn.recv(timeout=self.stall_timeout)
+            except socket.timeout:
+                # the transport's stall deadline fired: no bytes for
+                # stall_timeout.  With nothing pending that is just an
+                # idle connection — keep listening (the gap deadline
+                # consumed no partial frame, so the stream stays synced).
+                # With requests pending the peer is wedged: fail them
+                # all loudly and close — the stream may now be mid-frame
+                with self._lock:
+                    n_pending = len(self._pending)
+                if n_pending == 0:
+                    continue
+                self._fail_all(ServingError(
+                    "stalled",
+                    f"server sent no bytes for {self.stall_timeout:.1f}s "
+                    f"with {n_pending} request(s) pending",
+                ))
+                self.conn.close()
+                return
             except Exception:
                 self._fail_all(ConnectionResetError("serving connection lost"))
                 return
@@ -61,6 +104,17 @@ class ServingClient:
             with self._lock:
                 fut = self._pending.pop(rid, None)
             if fut is None or fut.done():
+                # missing/unknown/duplicate rid: a desynced or misbehaving
+                # server must be visible, not silently absorbed
+                self.replies_orphaned += 1
+                if not self._orphan_warned:
+                    self._orphan_warned = True
+                    print(
+                        f"serving client: orphaned reply frame "
+                        f"(kind={kind!r}, rid={rid!r}) — counting in "
+                        "replies_orphaned; further orphans are silent",
+                        file=sys.stderr,
+                    )
                 continue
             if kind == "error":
                 fut.set_exception(
@@ -68,7 +122,7 @@ class ServingClient:
                 )
             elif kind == "stats":
                 fut.set_result(data.get("stats"))
-            else:  # result / swapped
+            else:  # result / swapped / session / session_closed
                 fut.set_result(data)
 
     def _fail_all(self, exc: Exception) -> None:
@@ -99,18 +153,32 @@ class ServingClient:
     # -- API ----------------------------------------------------------------
 
     def submit(self, obs, model=-1, hidden=None,
-               slo_ms: Optional[float] = None) -> Future:
-        """Async inference; resolves to {"model": served_id, "out": tree}."""
+               slo_ms: Optional[float] = None,
+               sid: Optional[str] = None) -> Future:
+        """Async inference; resolves to {"model": served_id, "out": tree}.
+        With ``sid`` the server reads/writes the session's hidden state —
+        the wire carries neither direction of it."""
         data: Dict[str, Any] = {"model": model, "obs": obs}
         if hidden is not None:
             data["hidden"] = hidden
         if slo_ms is not None:
             data["slo_ms"] = float(slo_ms)
+        if sid is not None:
+            data["sid"] = sid
         return self._send("infer", data)
 
     def infer(self, obs, model=-1, hidden=None, slo_ms: Optional[float] = None,
+              sid: Optional[str] = None,
               timeout: float = 60.0) -> Dict[str, Any]:
-        return self.submit(obs, model, hidden, slo_ms).result(timeout=timeout)
+        return self.submit(obs, model, hidden, slo_ms, sid).result(timeout=timeout)
+
+    def open_session(self, model=-1, timeout: float = 30.0) -> str:
+        """Open a server-resident recurrent session; returns its sid."""
+        reply = self._send("open_session", {"model": model}).result(timeout=timeout)
+        return reply["sid"]
+
+    def close_session(self, sid: str, timeout: float = 30.0) -> Dict[str, Any]:
+        return self._send("close_session", {"sid": sid}).result(timeout=timeout)
 
     def stats(self, timeout: float = 30.0) -> Dict[str, Any]:
         return self._send("stats", {}).result(timeout=timeout)
@@ -125,6 +193,10 @@ class ServingClient:
             # tree (fresh from a train step) converts here, once
             data["params"] = tree_map(np.asarray, params)
         return self._send("swap", data).result(timeout=timeout)
+
+    def wire_bytes(self) -> Tuple[int, int]:
+        """(sent, received) frame bytes on this connection so far."""
+        return self.conn.bytes_sent, self.conn.bytes_received
 
     def close(self) -> None:
         with self._lock:

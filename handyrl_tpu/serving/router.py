@@ -473,6 +473,11 @@ class ModelRouter:
         total = lambda key: sum(s[key] for s in per_engine) + retired.get(key, 0)
         return {
             "models": n_models,
+            # instantaneous queue pressure across engines (queued + on the
+            # device): the fleet front-end's balancing signal, polled via
+            # the stats frame — NOT in _COUNTER_KEYS (it is a gauge, so
+            # retired engines contribute nothing by construction)
+            "depth": sum(s["depth"] + s["inflight"] for s in per_engine),
             "requests_admitted": total("requests_admitted"),
             "requests_served": total("requests_served"),
             "requests_shed": total("requests_shed"),

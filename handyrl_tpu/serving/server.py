@@ -12,12 +12,18 @@ intake, which is what lets thousands of connections share one server.
 
 Wire protocol (codec frames, all request/reply pairs carry ``rid``):
 
-    -> ("infer", {"rid", "model", "obs", "hidden"?, "slo_ms"?})
-    <- ("result", {"rid", "model": served_id, "out": numpy tree})
+    -> ("infer", {"rid", "model", "obs", "hidden"?, "slo_ms"?, "sid"?})
+    <- ("result", {"rid", "model": served_id, "out": numpy tree, "sid"?})
     <- ("error",  {"rid", "kind": shed|deadline|stopped|bad_request|..., "msg"})
     -> ("stats", {"rid"})               <- ("stats", {"rid", "stats": {...}})
     -> ("swap",  {"rid", "id", "params"?})  <- ("swapped", {"rid", "id", "warm_ms"})
+    -> ("open_session",  {"rid", "model"?})  <- ("session", {"rid", "sid"})
+    -> ("close_session", {"rid", "sid"})     <- ("session_closed", {"rid", "sid", "existed"})
     -> ("heartbeat", None)              (liveness only, never replied)
+
+An ``infer`` carrying a ``sid`` reads/writes the session's recurrent
+hidden state server-side (fleet/sessions.py) — the wire carries neither
+direction of it, and the reply's ``out`` has its ``hidden`` stripped.
 
 ``swap`` with no params loads ``{id}.ckpt`` digest-verified from the
 checkpoint manifest; the warm-then-flip sequence lives in the router.
@@ -27,8 +33,6 @@ automatically when training publishes a newer verified snapshot.
 
 from __future__ import annotations
 
-import json
-import os
 import queue as _queue
 import threading
 import time
@@ -42,7 +46,9 @@ from ..runtime.connection import (
     open_socket_connection,
     accept_socket_connections,
 )
+from ..fleet.sessions import SessionCache
 from ..runtime.inference_engine import EngineStopped
+from ..utils.metrics import append_metrics_record
 from ..utils.trace import trace_event
 from .router import ColdRoute, ModelRouter
 
@@ -86,6 +92,18 @@ class ServingServer(QueueCommunicator):
 
         self._cold_pool = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="serve-cold"
+        )
+        # server-resident recurrent sessions (docs/serving.md §Fleet tier):
+        # open_session/infer(sid)/close_session pin hidden state here so
+        # the wire carries only observations.  session_capacity: 0 turns
+        # the tier off — ship-state-both-ways stays the stateless fallback
+        # either way.  The cache adopts the serving engine's device on
+        # first use (engine placement is the router's call)
+        session_capacity = int(cfg.get("session_capacity", 1024))
+        self.sessions: Optional[SessionCache] = (
+            SessionCache(session_capacity, int(cfg.get("session_spill", 4096)))
+            if session_capacity > 0
+            else None
         )
         self._stats_lock = threading.Lock()
         self.requests_in = 0
@@ -165,6 +183,10 @@ class ServingServer(QueueCommunicator):
                     # frames queues instead of spawning a warming thread
                     # (and a racing publish) apiece
                     self._cold_pool.submit(self._handle_swap, conn, data)
+                elif req == "open_session":
+                    self._handle_open_session(conn, rid)
+                elif req == "close_session":
+                    self._handle_close_session(conn, rid, data.get("sid"))
                 else:
                     self._error(conn, rid, "bad_request",
                                 f"unknown request {req!r}")
@@ -206,6 +228,21 @@ class ServingServer(QueueCommunicator):
             self._error(conn, data.get("rid"), "error",
                         f"{type(exc).__name__}: {exc}")
 
+    def _handle_open_session(self, conn: FramedConnection, rid) -> None:
+        if self.sessions is None:
+            self._error(conn, rid, "bad_request",
+                        "session cache disabled (serving.session_capacity: 0)")
+            return
+        self.send(conn, ("session", {"rid": rid, "sid": self.sessions.open()}))
+
+    def _handle_close_session(self, conn: FramedConnection, rid, sid) -> None:
+        if self.sessions is None or not isinstance(sid, str):
+            self._error(conn, rid, "bad_request", f"bad session id {sid!r}")
+            return
+        existed = self.sessions.close(sid)
+        self.send(conn, ("session_closed",
+                         {"rid": rid, "sid": sid, "existed": existed}))
+
     def _do_infer(self, conn: FramedConnection, data: Dict[str, Any],
                   allow_cold: bool = True) -> None:
         rid = data.get("rid")
@@ -224,6 +261,19 @@ class ServingServer(QueueCommunicator):
                 self._error(conn, rid, "bad_request",
                             f"slo_ms={slo_ms!r} is not a number")
                 return
+        sid = data.get("sid")
+        hidden = data.get("hidden")
+        if sid is not None and self.sessions is None:
+            self._error(conn, rid, "bad_request",
+                        "session cache disabled (serving.session_capacity: 0)")
+            return
+        if sid is not None and hidden is None:
+            # session path: the hidden state lives HERE, next to the model
+            # (an explicit wire hidden still wins — the stateless override).
+            # A miss (spill overflow, or a session re-routed off a dead
+            # replica) falls back to the model's initial state and is
+            # counted — the client keeps playing, degraded loudly in stats
+            hidden, _status = self.sessions.lookup(sid)
         for attempt in (0, 1):
             try:
                 served, route = self.router.resolve(model_id, allow_cold=allow_cold)
@@ -232,7 +282,7 @@ class ServingServer(QueueCommunicator):
             except Exception as exc:
                 self._error(conn, rid, getattr(exc, "kind", "bad_request"), str(exc))
                 return
-            fut = route.submit(data.get("obs"), data.get("hidden"), deadline)
+            fut = route.submit(data.get("obs"), hidden, deadline)
             if (
                 attempt == 0
                 and fut.done()
@@ -243,13 +293,17 @@ class ServingServer(QueueCommunicator):
                 # retirement it never chose
                 continue
             break
+        if sid is not None and self.sessions.device is None:
+            # adopt the engine's device once so resident state stacks into
+            # future batches without a per-request host upload
+            self.sessions.device = getattr(route, "device", None)
         fut.add_done_callback(
-            lambda f, c=conn, r=rid, s=served, a=arrival:
-                self._reply(c, r, s, f, a)
+            lambda f, c=conn, r=rid, s=served, a=arrival, i=sid:
+                self._reply(c, r, s, f, a, i)
         )
 
     def _reply(self, conn: FramedConnection, rid, served, fut,
-               arrival: Optional[float] = None) -> None:
+               arrival: Optional[float] = None, sid=None) -> None:
         exc = fut.exception()
         if arrival is not None:
             # the request lifecycle as one span: frame arrival (admission)
@@ -263,7 +317,17 @@ class ServingServer(QueueCommunicator):
         if exc is None:
             with self._stats_lock:
                 self.replies += 1
-            self.send(conn, ("result", {"rid": rid, "model": served, "out": fut.result()}))
+            out = fut.result()
+            if sid is not None and isinstance(out, dict) and "hidden" in out:
+                # the session's whole point: the next-step state stays
+                # here (store() re-pins it device-side) and the reply
+                # frame sheds its largest tensor.  out is this request's
+                # own scatter slice, so popping mutates nothing shared
+                self.sessions.store(sid, out.pop("hidden"))
+            reply = {"rid": rid, "model": served, "out": out}
+            if sid is not None:
+                reply["sid"] = sid
+            self.send(conn, ("result", reply))
         else:
             kind = getattr(exc, "kind", None) or (
                 "stopped" if isinstance(exc, EngineStopped) else "error"
@@ -332,6 +396,7 @@ class ServingServer(QueueCommunicator):
             "serve_shed": rstats["requests_shed"],
             "serve_deadline_miss": rstats["deadline_misses"],
             "serve_batches": rstats["batches_served"],
+            "serve_depth": rstats["depth"],
             "serve_qps": round(served_delta / dt, 2),
             "serve_p50_ms": rstats["p50_ms"],
             "serve_p99_ms": rstats["p99_ms"],
@@ -341,6 +406,8 @@ class ServingServer(QueueCommunicator):
             "serve_connections": self.connection_count(),
             "serve_errors": sum(errors.values()),
         }
+        if self.sessions is not None:
+            record.update(self.sessions.stats())
         return record
 
     def _metrics_loop(self) -> None:
@@ -355,18 +422,9 @@ class ServingServer(QueueCommunicator):
 
     def _write_metrics(self, record: Dict[str, Any]) -> None:
         """Learner._write_metrics discipline: one flushed+fsynced append
-        per record, so readers tolerate at most a truncated tail line."""
-        # same timestamp seam as the learner's records (ts wall / t_mono)
-        record.setdefault("ts", round(time.time(), 6))
-        record.setdefault("t_mono", round(time.monotonic(), 6))
-        line = json.dumps(record, default=float) + "\n"
-        with open(self._metrics_path, "a") as f:
-            f.write(line)
-            f.flush()
-            try:
-                os.fsync(f.fileno())
-            except OSError:
-                pass
+        per record (timestamp seam included), so readers tolerate at most
+        a truncated tail line — shared with the fleet router's records."""
+        append_metrics_record(self._metrics_path, record)
 
 
 def serve_main(args: Dict[str, Any]) -> None:

@@ -40,9 +40,24 @@ METRIC_KEYS = frozenset({
     # rest are the ServingServer's periodic health records — exact keys,
     # not a prefix family, so every new serving stat is reviewed here
     "serve_snapshot_substituted", "serve_requests", "serve_replies",
-    "serve_shed", "serve_deadline_miss", "serve_batches", "serve_qps",
-    "serve_p50_ms", "serve_p99_ms", "serve_hot_swaps", "serve_models",
-    "serve_connections", "serve_errors",
+    "serve_shed", "serve_deadline_miss", "serve_batches", "serve_depth",
+    "serve_qps", "serve_p50_ms", "serve_p99_ms", "serve_hot_swaps",
+    "serve_models", "serve_connections", "serve_errors",
+    # server-resident session cache (handyrl_tpu/fleet/sessions.py),
+    # folded into the ServingServer's periodic record: residency gauges
+    # plus cumulative lifecycle/eviction/restore/affinity-miss counters —
+    # exact keys, like serve_*, so every new session stat is reviewed here
+    "session_resident", "session_spilled", "session_opened",
+    "session_closed", "session_evictions", "session_restored",
+    "session_affinity_miss",
+    # fleet front-end (handyrl_tpu/fleet/router_tier.py): the session-
+    # affinity router's periodic health records — proxy volume, replica
+    # liveness (fleet_replica_lost counts loss EVENTS; fleet_replicas_live
+    # is the current gauge), sessions routed, and orchestrated fleet-wide
+    # hot-swaps
+    "fleet_requests", "fleet_replies", "fleet_errors", "fleet_qps",
+    "fleet_replicas", "fleet_replicas_live", "fleet_replica_lost",
+    "fleet_sessions", "fleet_hot_swaps",
     # league plane (handyrl_tpu/league): per-epoch population health from
     # LeagueLearner._epoch_hook — exact keys, like serve_*, so every new
     # league stat is reviewed here.  league_matches/forfeits/promotions
@@ -73,6 +88,28 @@ METRIC_KEYS = frozenset({
 # staleness); trace_*: cumulative tracer health (spans recorded, ring
 # drops) from utils/trace.trace_stats
 METRIC_KEY_PREFIXES = ("pipe_", "plane_", "sentinel_", "rank_", "trace_")
+
+
+def append_metrics_record(path: str, record: Dict[str, Any]) -> None:
+    """One flushed+fsynced appended line — the Learner._write_metrics
+    discipline shared by every periodic metrics writer (serving server,
+    fleet router): a kill mid-append leaves at most ONE truncated line,
+    and only at the tail, which ``read_metrics`` tolerates.  Stamps the
+    dual-clock seam (ts wall / t_mono monotonic) like the learner's
+    records so readers align cross-host and rate-math safely."""
+    import os
+    import time
+
+    record.setdefault("ts", round(time.time(), 6))
+    record.setdefault("t_mono", round(time.monotonic(), 6))
+    line = json.dumps(record, default=float) + "\n"
+    with open(path, "a") as f:
+        f.write(line)
+        f.flush()
+        try:
+            os.fsync(f.fileno())
+        except OSError:
+            pass
 
 
 def read_metrics(path: str, strict: bool = False) -> List[Dict[str, Any]]:

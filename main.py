@@ -6,6 +6,10 @@ Modes:
     --worker / -w            worker machine connecting to a train server
     --serve / -s             standalone inference serving plane
                              (continuous batching + hot-swap; docs/serving.md)
+    --fleet / -f             fleet front-end: session-affinity router over
+                             the replicas in fleet.replicas (docs/serving.md)
+    --edge [ARTIFACT]        CPU edge replica serving a frozen export
+                             artifact (fleet capability tag: edge)
     --league / -l            population-based league training (PFSP
                              matchmaking + promotion gate; docs/league.md)
     --eval / -e              MODEL_PATH NUM_GAMES NUM_PROCESS
@@ -65,6 +69,16 @@ if __name__ == "__main__":
         from handyrl_tpu.serving import serve_main
 
         serve_main(args)
+    elif mode in ("--fleet", "-f"):
+        from handyrl_tpu.fleet import fleet_main
+
+        fleet_main(args)
+    elif mode == "--edge":
+        from handyrl_tpu.fleet import edge_main
+
+        if len(sys.argv) > 2:
+            args["edge_model"] = sys.argv[2]
+        edge_main(args)
     elif mode in ("--league", "-l"):
         from handyrl_tpu.league import league_main
         from handyrl_tpu.parallel import init_distributed

@@ -229,6 +229,10 @@ class LintConfig:
         # hot seam (dispatch_serialized, batch waits, cadence): a host
         # sync here would be charged to every dispatch in the repo
         "handyrl_tpu/utils/trace.py",
+        # the fleet tier sits on the serving request path twice (router
+        # proxy + session cache lookup/store on every stateful infer):
+        # a stray host sync is a per-request latency regression
+        "handyrl_tpu/fleet/*.py",
     )
     # functions (bare names) that are drain/teardown/construction paths —
     # host syncs there are the POINT, not a leak
@@ -265,6 +269,9 @@ class LintConfig:
         # the tracer must never dispatch device programs at all — any jit
         # call appearing here is a bug, and DL002 makes it lock-scoped
         "handyrl_tpu/utils/trace.py",
+        # the session cache touches the device (re-pin on restore) next
+        # to serving engines sharing the same chips: same lock discipline
+        "handyrl_tpu/fleet/*.py",
     )
     dispatch_wrapper: str = "dispatch_serialized"
 
@@ -275,7 +282,7 @@ class LintConfig:
     # every other dict-valued default (mesh, ...) is one knob
     cfg005_nested: Tuple[str, ...] = (
         "worker", "distributed", "eval", "serving", "league", "trace",
-        "observability",
+        "observability", "fleet",
     )
     # documented spellings that are intentionally not defaults (aliases
     # normalized away before validation)
@@ -288,6 +295,8 @@ class LintConfig:
         "handyrl_tpu/runtime/trainer.py",
         "handyrl_tpu/serving/server.py",
         "handyrl_tpu/league/learner.py",
+        "handyrl_tpu/fleet/router_tier.py",
+        "handyrl_tpu/fleet/sessions.py",
     )
     # module-level *_KEYS tuples that feed metrics keys, with the prefix
     # they are written under
