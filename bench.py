@@ -2243,11 +2243,158 @@ def _league_bench(duration: float):
     return out
 
 
+def _lowprec_bench(duration: float):
+    """Low-precision fast path (docs/performance.md §Low-precision): both
+    precision rungs MEASURED in one session so the ratios divide out the
+    day's RTT/lease variance.
+
+    Weight rung: resident param bytes fp32 vs int8 (models/quantize.py
+    per-channel symmetric), engine inference rate per rung through the
+    same jitted-apply path the serving plane dispatches, and the
+    publish-time calibration record (measured output deviation over
+    replay obs).  Obs rung: identical seeded self-play encoded fp32 vs
+    int8 — raw obs bytes moved and compressed wire bytes — plus train
+    updates/s consuming each encoding (int8 windows dequantize inside
+    the jitted sample/forward programs).  Parity is MEASURED, never
+    assumed: a short-trained policy pits its int8 engine against its
+    fp32 engine seat-balanced through the league's PayoffMatrix ledger
+    (|wp - 0.5| <= 0.03 over >= 400 games; QUICK mode plays 40 — enough
+    to exercise the verdict path, not to bank it).  On CPU the byte
+    ratios are exact and portable; the rates are proxy numbers (no MXU,
+    no HBM) — BENCH_r06 TPU capture instructions in docs/performance.md."""
+    import random as _random
+
+    import jax
+    import numpy as np
+
+    from handyrl_tpu.agents import Agent
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.league.matchmaker import PayoffMatrix
+    from handyrl_tpu.models import build_inference_model
+    from handyrl_tpu.models.quantize import (
+        calibration_batches_from_store, calibration_report, obs_quant_spec,
+        param_bytes, quantize_params,
+    )
+    from handyrl_tpu.parallel import TrainContext, make_mesh
+    from handyrl_tpu.runtime.evaluation import evaluate_mp
+    from handyrl_tpu.runtime.replay import decompress_block
+    from handyrl_tpu.utils import tree_map
+
+    out = {"backend": jax.default_backend()}
+    fill = 12 if QUICK else 32
+
+    # -- weight rung ------------------------------------------------------
+    args = _make_args("TicTacToe", {"batch_size": 32, "forward_steps": 8})
+    _random.seed(1009)
+    env, module, model, store = _fill_store(args, fill)
+    params = model.variables["params"]
+    out["weight_bytes_fp32"] = param_bytes(params)
+    out["weight_bytes_int8"] = param_bytes(quantize_params(params))
+    out["weight_bytes_ratio"] = out["weight_bytes_fp32"] / out["weight_bytes_int8"]
+
+    env.reset()
+    obs = env.observation(env.players()[0])
+    B = 64
+    obs_b = tree_map(lambda x: np.broadcast_to(np.asarray(x)[None],
+                                               (B,) + np.asarray(x).shape).copy(),
+                     obs)
+    for rung, dtype in (("fp32", "float32"), ("int8", "int8")):
+        eng = build_inference_model(module, params, dtype)
+        hidden = eng.init_hidden((B,))
+        rate = _timed_loop(
+            lambda: eng.inference_batch_async(obs_b, hidden), duration / 4
+        )
+        out[f"infer_qps_{rung}"] = rate * B
+    out["infer_int8_vs_fp32"] = out["infer_qps_int8"] / out["infer_qps_fp32"]
+
+    calib = calibration_report(
+        module, params, calibration_batches_from_store(store, 4)
+    )
+    out["calib_batches"] = calib["calib_batches"]
+    out["calib_max_dev"] = calib["calib_max_dev"]
+    out["calib_mean_dev"] = calib["calib_mean_dev"]
+
+    # -- obs rung: identical seeded self-play, fp32 vs int8 encoding ------
+    train_ups = {}
+    obs_bytes = {}
+    wire_bytes = {}
+    ctx_f = state_f = None
+    for rung, flag in (("fp32", False), ("int8", True)):
+        targs = _make_args("TicTacToe", {"batch_size": 32, "forward_steps": 8,
+                                         "obs_int8": flag})
+        _random.seed(123)  # SAME trajectories both rungs: only encoding differs
+        _, mod2, model2, store2 = _fill_store(targs, fill)
+        raw = blob = 0
+        for ep in store2.snapshot():
+            blob += sum(len(b) for b in ep["blocks"])
+            for b in ep["blocks"]:
+                raw += sum(
+                    leaf.nbytes
+                    for leaf in jax.tree.leaves(decompress_block(b)["obs"])
+                )
+        obs_bytes[rung], wire_bytes[rung] = raw, blob
+        if flag:
+            targs["_obs_quant"] = obs_quant_spec(make_env(targs["env"]))
+        ctx = TrainContext(mod2, targs, make_mesh(targs["mesh"]))
+        state = ctx.init_state(model2.variables["params"])
+        batches = [ctx.put_batch(_sample_batch(store2, targs)) for _ in range(4)]
+        holder = {"state": state, "i": 0}
+
+        def step():
+            holder["state"], metrics = ctx.train_step(
+                holder["state"], batches[holder["i"] % 4], 1e-3
+            )
+            holder["i"] += 1
+            return metrics["total"]
+
+        train_ups[rung] = _timed_loop(step, duration / 4)
+        if not flag:
+            ctx_f, state_f, batches_f, holder_f = ctx, state, batches, holder
+    out["obs_bytes_fp32"], out["obs_bytes_int8"] = obs_bytes["fp32"], obs_bytes["int8"]
+    out["obs_bytes_ratio"] = obs_bytes["fp32"] / obs_bytes["int8"]
+    out["wire_bytes_ratio"] = wire_bytes["fp32"] / wire_bytes["int8"]
+    out["train_updates_per_sec_fp32"] = train_ups["fp32"]
+    out["train_updates_per_sec_int8"] = train_ups["int8"]
+    out["train_int8_vs_fp32"] = train_ups["int8"] / train_ups["fp32"]
+
+    # -- wp parity: int8 engine vs fp32 engine, SAME short-trained params --
+    # (a uniform random policy would make any parity bar vacuous, so keep
+    # training the fp32 context briefly before extracting the params)
+    t_end = time.perf_counter() + min(duration, 12.0)
+    while time.perf_counter() < t_end:
+        holder_f["state"], m = ctx_f.train_step(
+            holder_f["state"], batches_f[holder_f["i"] % 4], 1e-3
+        )
+        holder_f["i"] += 1
+    jax.block_until_ready(m["total"])
+    trained = tree_map(np.asarray, jax.device_get(holder_f["state"]["params"]))
+
+    games = 40 if QUICK else 400
+    a_q = Agent(build_inference_model(module, trained, "int8"),
+                temperature=1.0, seed=11)
+    a_f = Agent(build_inference_model(module, trained, "float32"),
+                temperature=1.0, seed=12)
+    results = evaluate_mp({"env": "TicTacToe"}, {0: a_q, 1: a_f},
+                          games, num_workers=2)
+    payoff = PayoffMatrix()
+    for _pat, res in results.items():
+        for outcome, count in res.items():
+            payoff.record_score("int8", "fp32", float(outcome),
+                                -float(outcome), n=count)
+    wp = payoff.win_points("int8", "fp32")
+    out["wp"] = wp
+    out["wp_games"] = payoff.games("int8", "fp32")
+    out["wp_delta"] = abs(wp - 0.5)
+    out["wp_parity_target_met"] = out["wp_delta"] <= 0.03
+    return out
+
+
 KNOWN_STAGES = (
     "tictactoe", "device-selfplay", "geese-device-selfplay", "geese-gen",
     "geese-train", "northstar", "northstar2", "northstar3", "northstar4",
     "geese-bf16", "geister", "geister-device-selfplay", "geister-devreplay",
-    "serving", "fleet", "league", "transformer", "transformer_long", "flash",
+    "serving", "fleet", "league", "lowprec", "transformer",
+    "transformer_long", "flash",
 )
 # stages that consume another stage's result (main() gates them on it)
 STAGE_DEPS = {
@@ -2837,6 +2984,59 @@ def main() -> None:
             )
 
     _run_stage(result, "league", stage_league)
+
+    # 3h. low-precision fast path (docs/performance.md §Low-precision):
+    # both precision rungs measured in one session — weight/obs bytes
+    # moved, engine rate and train updates/s per rung, the measured
+    # calibration record, and the pinned wp-parity verdict
+    def stage_lowprec():
+        lp = _lowprec_bench(T_TRAIN)
+        result["extra"]["lowprec_backend_note"] = (
+            f"{lp['backend']}: byte ratios exact/portable; rates are "
+            "proxy off-TPU (no MXU/HBM)" if lp["backend"] != "tpu"
+            else "tpu"
+        )
+        result["extra"]["lowprec_weight_bytes_fp32"] = lp["weight_bytes_fp32"]
+        result["extra"]["lowprec_weight_bytes_int8"] = lp["weight_bytes_int8"]
+        result["extra"]["lowprec_weight_bytes_ratio"] = round(
+            lp["weight_bytes_ratio"], 3
+        )
+        result["extra"]["lowprec_infer_qps_fp32"] = _sig(lp["infer_qps_fp32"])
+        result["extra"]["lowprec_infer_qps_int8"] = _sig(lp["infer_qps_int8"])
+        result["extra"]["lowprec_infer_int8_vs_fp32"] = round(
+            lp["infer_int8_vs_fp32"], 3
+        )
+        result["extra"]["lowprec_calib_batches"] = lp["calib_batches"]
+        result["extra"]["lowprec_calib_max_dev"] = lp["calib_max_dev"]
+        result["extra"]["lowprec_calib_mean_dev"] = lp["calib_mean_dev"]
+        result["extra"]["lowprec_obs_bytes_ratio"] = round(
+            lp["obs_bytes_ratio"], 3
+        )
+        result["extra"]["lowprec_wire_bytes_ratio"] = round(
+            lp["wire_bytes_ratio"], 3
+        )
+        result["extra"]["lowprec_train_updates_per_sec_fp32"] = _sig(
+            lp["train_updates_per_sec_fp32"]
+        )
+        result["extra"]["lowprec_train_updates_per_sec_int8"] = _sig(
+            lp["train_updates_per_sec_int8"]
+        )
+        result["extra"]["lowprec_train_int8_vs_fp32"] = round(
+            lp["train_int8_vs_fp32"], 3
+        )
+        result["extra"]["lowprec_wp"] = round(lp["wp"], 4)
+        result["extra"]["lowprec_wp_games"] = lp["wp_games"]
+        result["extra"]["lowprec_wp_delta"] = round(lp["wp_delta"], 4)
+        result["extra"]["lowprec_wp_parity_target_met"] = lp[
+            "wp_parity_target_met"
+        ]
+        if not lp["wp_parity_target_met"] and not QUICK:
+            result["error"] = (result["error"] or "") + (
+                " lowprec: |wp - 0.5| = %.4f above the 0.03 parity bar "
+                "over %d games" % (lp["wp_delta"], lp["wp_games"])
+            )
+
+    _run_stage(result, "lowprec", stage_lowprec)
 
     # 4c. turn-mode device-resident replay: Geister DRC trained straight
     # from device rings (all-player burn-in windows, runtime/device_replay

@@ -236,6 +236,18 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
         # session_restored); beyond this the oldest spill is dropped and
         # its next touch is an affinity miss (fresh initial state)
         "session_spill": 4096,
+        # engine param residency: 'float32' (exact) or 'int8' (per-channel
+        # symmetric weight-only quantization, fp32 scales, dequantize
+        # fused into the compiled apply — models/quantize.py).  Applied
+        # at engine build, so ModelRouter engines, fleet replicas, and
+        # frozen league opponents all inherit it; win-rate parity is
+        # MEASURED by the lowprec bench stage, never assumed
+        "weight_dtype": "float32",
+        # replay-episode calibration batches sampled at publish when
+        # weight_dtype is int8: the router replays stored observations
+        # through the fp32 and int8 engines and logs the measured output
+        # deviation (0 = skip the calibration record)
+        "calibration_batches": 4,
     },
     # --- fleet serving tier (docs/serving.md §Fleet tier) ----------------
     # `main.py --fleet`: a front-end entry port proxying rid-pipelined
@@ -374,6 +386,14 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
     # 'bfloat16' runs the forward/backward compute in bf16 (MXU rate)
     # with fp32 master weights; 'float32' is exact
     "compute_dtype": "float32",
+    # quantize observation planes to int8 at episode finalize: the actor
+    # wire blocks, shm ring slots, and device replay rings then carry
+    # int8 obs (4x fewer bytes) and dequantize on device inside the
+    # compiled sample/train programs.  Static per-plane scale/zero-point
+    # come from env metadata (env.obs_int8_spec(); default scale 1.0 /
+    # zero-point 0 — EXACT for 0/1-occupancy planes, which is every
+    # bundled env).  models/quantize.py
+    "obs_int8": False,
     # multiplies the reference lr schedule (3e-8 x data-count EMA,
     # train.py:328-332) -- 1.0 is exact parity.  The schedule assumes
     # GPU-scale update counts; raise it when the update budget is small
@@ -695,6 +715,21 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
                 f"train_args.serving.{key} must be >= 0 "
                 "(session_capacity 0 disables the session cache)"
             )
+    if serving["weight_dtype"] not in ("float32", "int8"):
+        raise ValueError(
+            f"train_args.serving.weight_dtype={serving['weight_dtype']!r} "
+            "not one of ('float32', 'int8')"
+        )
+    if int(serving["calibration_batches"]) < 0:
+        raise ValueError(
+            "train_args.serving.calibration_batches must be >= 0 (0 = skip "
+            "the publish-time calibration record)"
+        )
+    if not isinstance(train["obs_int8"], bool):
+        raise ValueError(
+            f"train_args.obs_int8={train['obs_int8']!r} must be a bool "
+            "(int8 observation planes on the wire/rings)"
+        )
     fleet = train["fleet"]
     for key in ("port", "edge_port"):
         if not isinstance(fleet[key], int) or not 0 <= fleet[key] <= 65535:

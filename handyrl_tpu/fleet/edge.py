@@ -180,7 +180,10 @@ def edge_main(args: Dict[str, Any]) -> None:
     from ..models.export import ExportedModel, OnnxModel
 
     # .onnx needs the optional onnxruntime; the jax.export artifact
-    # (.jaxm) runs on the baked-in toolchain — both serve identically
+    # (.jaxm) runs on the baked-in toolchain — both serve identically.
+    # Quantized exports (model.int8.onnx, scripts/export_model.py) land
+    # in the same branch: the dequantize rides inside the graph as
+    # Cast/Mul nodes, so the ~2x-smaller artifact needs no loader support
     model = OnnxModel(path) if str(path).endswith(".onnx") else ExportedModel(path)
     replica = EdgeReplica(
         model,

@@ -1,6 +1,12 @@
 from .nets import SimpleConvNet, GeeseNet, GeisterNet
 from .transformer import TransformerNet
-from .inference import InferenceModel, RandomModel, fetch_outputs, init_variables
+from .inference import (
+    InferenceModel,
+    RandomModel,
+    build_inference_model,
+    fetch_outputs,
+    init_variables,
+)
 from .export import ExportedModel, OnnxModel, export_model, export_onnx
 
 __all__ = [
@@ -10,6 +16,7 @@ __all__ = [
     "TransformerNet",
     "InferenceModel",
     "RandomModel",
+    "build_inference_model",
     "fetch_outputs",
     "init_variables",
     "ExportedModel",

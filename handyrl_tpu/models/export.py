@@ -210,7 +210,32 @@ def export_savedmodel(module, variables, sample_obs, path: str) -> None:
         f.write(codec.dumps(meta))
 
 
-def export_onnx(module, variables, sample_obs, path: str) -> None:
+class _DequantApplyShim:
+    """Module stand-in for the int8 ONNX export: holds int8-wrapped
+    ``variables`` and dequantizes inside the traced apply, so the int8
+    codes become int8 initializers in the artifact and the widen-to-fp32
+    becomes ordinary Cast/Mul graph ops ahead of each consuming matmul —
+    the serialized twin of ``quantize.jitted_dequant_apply``.  The apply
+    goes through that SAME jitted entry point on purpose: under
+    ``jax.make_jaxpr`` the jit boundary stages the dequantize into a pjit
+    sub-jaxpr whose int8 constants survive as int8 constvars, where an
+    inline ``astype`` on concrete arrays would constant-fold to fp32 and
+    silently ship full-width params."""
+
+    def __init__(self, module):
+        self._module = module
+
+    def initial_state(self, batch_dims):
+        return self._module.initial_state(batch_dims)
+
+    def apply(self, variables, obs, hidden):
+        from .quantize import jitted_dequant_apply
+
+        return jitted_dequant_apply(self._module)(variables, obs, hidden)
+
+
+def export_onnx(module, variables, sample_obs, path: str,
+                weight_dtype: str = "float32") -> None:
     """Freeze (module, variables) into a real ``.onnx`` file — the
     reference's exact artifact kind (scripts/make_onnx_model.py:28-58) —
     via the jaxpr->torch bridge (``torch_export.py``): the inference
@@ -225,9 +250,27 @@ def export_onnx(module, variables, sample_obs, path: str) -> None:
     ``hidden_N``, outputs keep their dict keys, next-step state as
     ``hidden_N_out``, batch axis dynamic.  A sidecar ``<path>.meta``
     carries the pytree structure + initial hidden so ``OnnxModel`` can
-    rebuild framework-shaped inputs/outputs."""
+    rebuild framework-shaped inputs/outputs.
+
+    ``weight_dtype='int8'`` (the ``.int8.onnx`` route in
+    scripts/export_model.py) per-channel-quantizes the kernels first and
+    traces through a dequantizing shim, so the artifact carries int8
+    initializers plus explicit Cast/Mul dequantize nodes — ~4x smaller
+    params on the edge-replica wire, numerics still verified against the
+    jax dequantize path before the file is written."""
     from ..runtime import codec
     from .torch_export import export_onnx_via_torch
+
+    if weight_dtype == "int8":
+        from .quantize import quantize_params
+
+        params = variables.get("params", variables)
+        # device_put up front: numpy constants entering the trace would
+        # stage device_put eqns the torch bridge (rightly) rejects
+        variables = jax.device_put(dict(variables, params=quantize_params(params)))
+        module = _DequantApplyShim(module)
+    elif weight_dtype != "float32":
+        raise ValueError(f"unknown weight_dtype for ONNX export: {weight_dtype!r}")
 
     fn, leaves, in_names, hidden0, n_obs = _bridge_fn(module, variables, sample_obs)
     probe = fn(*leaves)
@@ -248,6 +291,7 @@ def export_onnx(module, variables, sample_obs, path: str) -> None:
     export_onnx_via_torch(
         tup_fn, tiled, path,
         input_names=list(in_names), output_names=out_names,
+        constant_folding=(weight_dtype != "int8"),
     )
     meta = {
         "n_obs": n_obs,

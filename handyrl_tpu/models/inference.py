@@ -91,6 +91,25 @@ class InferenceModel(SingleInferenceMixin):
         return jax.device_get(outputs)
 
 
+def build_inference_model(module, params, weight_dtype: str = "float32"):
+    """THE engine-build seam for ``serving.weight_dtype``: every place
+    that wraps a published/loaded param tree into an engine model
+    (ModelRouter.publish, its cold-resolve path, the bench's serving
+    stages) goes through here, so the int8 rung reaches the serving
+    plane, the fleet replicas, and the frozen league opponents from one
+    switch.  Lazy import keeps the fp32 path free of the quantize
+    module."""
+    if weight_dtype == "int8":
+        from .quantize import QuantizedInferenceModel
+
+        return QuantizedInferenceModel(module, {"params": params})
+    if weight_dtype not in (None, "float32"):
+        raise ValueError(
+            f"weight_dtype must be 'float32' or 'int8', got {weight_dtype!r}"
+        )
+    return InferenceModel(module, {"params": params})
+
+
 class RandomModel:
     """Zero-logit stand-in (uniform policy over legal actions, zero value).
 

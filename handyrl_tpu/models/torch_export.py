@@ -487,7 +487,8 @@ class TorchJaxpr:
 
 def export_onnx_via_torch(fn, example_args, path: str,
                           input_names: List[str],
-                          output_names: List[str]) -> None:
+                          output_names: List[str],
+                          constant_folding: bool = True) -> None:
     """Trace ``fn``'s jaxpr-interpreting torch module and write a real
     ONNX ModelProto via torch's C++ serializer.  Verifies numerics at
     the example batch AND at a different batch through the traced graph
@@ -538,11 +539,16 @@ def export_onnx_via_torch(fn, example_args, path: str,
             lambda model_bytes, custom_opsets: model_bytes
         )
 
+    # constant_folding=False is the int8 export's request: folding would
+    # evaluate the dequantize (Cast+Mul on constant int8 buffers) at
+    # export time and bake full-width fp32 weights into the artifact,
+    # exactly what the quantized route exists to avoid
     torch.onnx.export(
         traced, tuple(tin), path,
         input_names=input_names,
         output_names=output_names,
         dynamic_axes={n: {0: "batch"} for n in input_names + output_names},
         opset_version=17,
+        do_constant_folding=constant_folding,
         dynamo=False,
     )

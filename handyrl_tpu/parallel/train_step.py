@@ -138,6 +138,14 @@ def forward_prediction(module, params, batch: Dict[str, Any], args: Dict[str, An
     arithmetic (the 1e32 action mask is not bf16-representable)."""
     cdt = _compute_dtype(args)
     obs = batch["observation"]
+    if any(x.dtype == jnp.int8 for x in jax.tree.leaves(obs)):
+        # obs_int8: host-fed batches carry int8 planes end-to-end (wire ->
+        # shm -> device upload); dequantize here, inside the jitted update,
+        # under the spec the generator quantized with (threaded by the
+        # learner as args['_obs_quant']; absent = identity scale)
+        from ..models.quantize import dequantize_obs_tree
+
+        obs = dequantize_obs_tree(obs, args.get("_obs_quant"))
     if cdt is not None:
         # observations (and params, cast by the caller) carry bf16 through
         # the net; recurrent hidden stays fp32 — the carry must keep one

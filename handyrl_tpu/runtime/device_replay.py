@@ -768,15 +768,29 @@ class EpisodeObsView:
     # presence; the stage drives ingest with pre-built record chunks
     record = None
 
-    def __init__(self, num_players: int, obs_treedef, n_obs_leaves: int):
+    def __init__(self, num_players: int, obs_treedef, n_obs_leaves: int,
+                 obs_spec=None):
         self.num_players = num_players
         self._treedef = obs_treedef
         self._n = n_obs_leaves
+        # obs_int8: per-leaf (scale, zero_point) the episode's obs planes
+        # were quantized under (rides in the episode dict as
+        # obs_scale/obs_zero); None = obs stored at native dtype
+        self._spec = obs_spec
 
     def _tree(self, compact: Dict[str, Any]):
-        return jax.tree.unflatten(
+        tree = jax.tree.unflatten(
             self._treedef, [compact[f"obs{i}"] for i in range(self._n)]
         )
+        if self._spec is not None:
+            # dequantize-on-device: runs INSIDE the jitted sample/assemble
+            # programs (XLA fuses convert+mul into the gather consumers),
+            # so the ring stays int8-resident and the host never touches
+            # float obs on this path
+            from ..models.quantize import dequantize_obs_tree
+
+            tree = dequantize_obs_tree(tree, self._spec)
+        return tree
 
     def view_obs(self, compact: Dict[str, Any], player):
         def pick(x):                         # (N, T, P, ...) -> (N, T, ...)
@@ -922,7 +936,15 @@ class DeviceEpisodeStage:
         for i, leaf in enumerate(obs_leaves):
             rec[f"obs{i}"] = np.asarray(leaf)
         if self.replay is None:
-            self._view = EpisodeObsView(P, treedef, len(obs_leaves))
+            spec = None
+            if episode.get("obs_scale") is not None:
+                # the quantization spec travels WITH the episode
+                # (generation.py _finalize) — no env re-derivation here
+                spec = list(zip(
+                    np.asarray(episode["obs_scale"], np.float32).tolist(),
+                    np.asarray(episode["obs_zero"], np.float32).tolist(),
+                ))
+            self._view = EpisodeObsView(P, treedef, len(obs_leaves), obs_spec=spec)
             self.replay = DeviceReplay(
                 self._view, self.module, self.args, self.mesh,
                 self.n_lanes, slots=self.slots,

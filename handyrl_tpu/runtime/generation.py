@@ -29,6 +29,16 @@ class Generator:
         self.env = env
         self.args = args
         self.on_step = on_step  # called once per env step (throughput probes)
+        # obs_int8: per-leaf (scale, zero_point), resolved once from env
+        # metadata (models/quantize.py obs_quant_spec)
+        self._obs_spec = None
+
+    def _obs_quant_spec(self, obs_template):
+        if self._obs_spec is None:
+            from ..models.quantize import obs_quant_spec
+
+            self._obs_spec = obs_quant_spec(self.env, obs=obs_template)
+        return self._obs_spec
 
     def generate(self, models: Dict[int, Any], args: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         env = self.env
@@ -166,15 +176,33 @@ class Generator:
                     ]
                 )
             cols["obs"] = self._stack_obs(obs_leaves)  # (t, P, ...) leaf-wise
+            if self.args.get("obs_int8"):
+                # quantize ONCE at finalize: the compressed wire blocks,
+                # the shm ring slots, and the device replay rings all
+                # inherit the int8 leaves; dequantize runs on device at
+                # the consumption seams (models/quantize.py)
+                from ..models.quantize import quantize_obs_tree
+
+                cols["obs"] = quantize_obs_tree(
+                    cols["obs"], self._obs_quant_spec(obs_template)
+                )
             blocks.append(compress_block(cols))
 
-        return {
+        episode = {
             "args": args,
             "steps": T,
             "players": players,
             "outcome": outcome,
             "blocks": blocks,
         }
+        if self.args.get("obs_int8"):
+            # the spec rides WITH the episode so every consumer (device
+            # stage, train step) dequantizes with the scales the data was
+            # actually quantized under — no env re-derivation stage-side
+            spec = self._obs_quant_spec(obs_template)
+            episode["obs_scale"] = np.asarray([s for s, _ in spec], np.float32)
+            episode["obs_zero"] = np.asarray([z for _, z in spec], np.float32)
+        return episode
 
     @staticmethod
     def _stack_obs(obs_leaves):

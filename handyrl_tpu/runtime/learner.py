@@ -193,6 +193,17 @@ class Learner:
             )
         else:
             mesh = make_mesh(self.args.get("mesh"))
+        if self.args.get("obs_int8"):
+            # thread the generator's quantization spec to the train step:
+            # forward_prediction dequantizes int8 obs planes under
+            # args['_obs_quant'], derived once from the same env metadata
+            # generation.py quantizes with
+            from ..models.quantize import obs_quant_spec
+
+            self.env.reset()
+            self.args["_obs_quant"] = obs_quant_spec(
+                self.env, obs=self.env.observation(self.env.players()[0])
+            )
         self.trainer = Trainer(self.args, self.module, params, mesh)
         if self._dist_nprocs > 1:
             # distributed epoch loop: the coordinator's boundary/shutdown/
@@ -262,6 +273,16 @@ class Learner:
                 # the file matches restart_epoch (an earlier epoch = branch)
                 self.trainer.load_state(state_path, self.model_epoch)
         self.model_server = self._make_model_server(args)
+        router = getattr(self.model_server, "_router", None)
+        if router is not None and getattr(router, "weight_dtype", "") == "int8":
+            # publish-time int8 calibration replays REAL stored episodes:
+            # the learner owns the episode store the router samples from
+            from ..models.quantize import calibration_batches_from_store
+
+            _store = self.trainer.store
+            router.calibration_source = lambda: calibration_batches_from_store(
+                _store, router.calibration_batches
+            )
         self.model_server.publish(self.model_epoch, params)
 
         self.remote = remote

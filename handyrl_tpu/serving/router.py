@@ -40,7 +40,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..agents import mean_pool_outputs
-from ..models import InferenceModel, RandomModel
+from ..models import InferenceModel, RandomModel, build_inference_model
 from ..runtime.checkpoint import latest_verified_epoch, load_verified_params
 from .batcher import BadRequest, ContinuousBatcher, ServeError, percentiles_ms
 
@@ -158,6 +158,22 @@ class ModelRouter:
             "shed_policy": cfg.get("shed_policy", "deadline"),
             "queue_bound": int(cfg.get("queue_bound", 1024)),
         }
+        # engine param residency (models/quantize.py): every engine this
+        # router builds — publish, cold resolve — goes through
+        # build_inference_model, so 'int8' reaches the serving plane, the
+        # fleet replicas, and the frozen league opponents from ONE knob
+        self.weight_dtype = cfg.get("weight_dtype", "float32")
+        self.calibration_batches = int(cfg.get("calibration_batches", 4))
+        # optional replay-obs source (callable -> list of batched obs
+        # pytrees) wired by owners that hold stored episodes; publish
+        # then records the MEASURED fp32-vs-int8 output deviation
+        self.calibration_source = None
+        self.last_calibration: Optional[Dict[str, float]] = None
+        # the fp32 checkpoint-shaped template publish() stores host-side:
+        # int8 engines hold a restructured variables tree, so manifest
+        # loads (serialization.from_bytes needs the fp32 structure) must
+        # never read it back out of an engine
+        self._template_params = None
         self._devices = list(devices) if devices is not None else list(jax.devices())
         self._spawned = 0
         self._lock = threading.Lock()
@@ -198,10 +214,12 @@ class ModelRouter:
         warm the standby engine off the hot path, then flip atomically.
         Returns the warm-up wall ms (the pre-paid part of
         time-to-first-response)."""
-        model = InferenceModel(self.module, {"params": params})
+        model = build_inference_model(self.module, params, self.weight_dtype)
         engine = self._spawn(model)
         warm_ms = engine.warm(self.warm_buckets, self._template_obs) if warm else 0.0
+        self._maybe_calibrate(params)
         with self._lock:
+            self._template_params = params
             if self._stopped:
                 displaced = None
             else:
@@ -239,11 +257,35 @@ class ModelRouter:
         self.publish(newest, params)
         return newest
 
+    def _maybe_calibrate(self, params) -> None:
+        """Publish-time calibration for the int8 rung: replay stored
+        observations (calibration_source, wired by owners with an episode
+        store) through the fp32 and int8 applies and record the measured
+        output deviation — never a weight-space bound."""
+        if (
+            self.weight_dtype != "int8"
+            or self.calibration_batches <= 0
+            or self.calibration_source is None
+        ):
+            return
+        from ..models.quantize import calibration_report
+
+        batches = list(self.calibration_source())[: self.calibration_batches]
+        if batches:
+            self.last_calibration = calibration_report(
+                self.module, params, batches
+            )
+
     def _params_template(self):
+        """The fp32 checkpoint-shaped param tree manifest loads
+        deserialize against.  Stored by publish() — an int8 engine's
+        resident ``variables['params']`` no longer matches the fp32
+        checkpoint structure, so reading it back out of an engine would
+        break ``serialization.from_bytes``."""
         with self._lock:
-            if self._latest_id is None:
+            if self._template_params is None:
                 raise RouteError("no model published yet")
-            return self._engines[self._latest_id].model.variables["params"]
+            return self._template_params
 
     _COUNTER_KEYS = (
         "requests_admitted", "requests_served", "requests_shed",
@@ -376,7 +418,9 @@ class ModelRouter:
             params = load_verified_params(
                 self.model_dir, mid, self._params_template()
             )
-            engine = self._spawn(InferenceModel(self.module, {"params": params}))
+            engine = self._spawn(
+                build_inference_model(self.module, params, self.weight_dtype)
+            )
             engine.warm(self.warm_buckets, self._template_obs)
         except Exception:
             # missing / GC'd / corrupt snapshot (or a failed spawn):

@@ -408,6 +408,16 @@ class ServingServer(QueueCommunicator):
         }
         if self.sessions is not None:
             record.update(self.sessions.stats())
+        if getattr(self.router, "weight_dtype", "float32") != "float32":
+            # low-precision rung: dtype pin + the publish-time MEASURED
+            # calibration record (None until a calibration_source is wired
+            # and a publish has run) — keys registered in METRIC_KEYS
+            record["lowprec_weight_dtype"] = self.router.weight_dtype
+            calib = getattr(self.router, "last_calibration", None)
+            if calib:
+                record["lowprec_calib_batches"] = calib["calib_batches"]
+                record["lowprec_calib_max_dev"] = calib["calib_max_dev"]
+                record["lowprec_calib_mean_dev"] = calib["calib_mean_dev"]
         return record
 
     def _metrics_loop(self) -> None:

@@ -66,6 +66,13 @@ METRIC_KEYS = frozenset({
     "league_population", "league_pool", "league_matches", "league_forfeits",
     "league_payoff_coverage", "league_candidate_wp", "league_elo_spread",
     "league_promotions",
+    # low-precision fast path (models/quantize.py, docs/performance.md
+    # §Low-precision): the serving plane's periodic record pins the
+    # engine weight dtype and the publish-time MEASURED calibration
+    # deviation — exact keys, like serve_*, so every new lowprec stat is
+    # reviewed here
+    "lowprec_weight_dtype", "lowprec_calib_batches",
+    "lowprec_calib_max_dev", "lowprec_calib_mean_dev",
     # multi-process learner plane (parallel/distributed.py + health.py):
     # dist_processes is the run's process count; the rest are cumulative
     # cross-host health events — heartbeat misses observed, collective-

@@ -57,7 +57,11 @@ def main() -> None:
     elif out.endswith(".onnx"):  # reference-parity ONNX artifact (optional dep)
         from handyrl_tpu.models.export import export_onnx
 
-        export_onnx(module, {"params": params}, obs, out)
+        # ``model.int8.onnx`` ships per-channel int8 kernels with explicit
+        # dequantize nodes (docs/performance.md §Low-precision fast path);
+        # the edge replica loads it through the same OnnxModel suffix branch
+        wd = "int8" if out.endswith(".int8.onnx") else "float32"
+        export_onnx(module, {"params": params}, obs, out, weight_dtype=wd)
     else:
         export_model(module, {"params": params}, obs, out)
     print(f"exported {ckpt} -> {out}")

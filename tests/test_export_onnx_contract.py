@@ -44,6 +44,11 @@ CASES = {
     "geese": {"env": "HungryGeese"},
     "geister_drc": {"env": "Geister"},
     "transformer": {"env": "TicTacToe", "net": "transformer"},
+    # low-precision fast path: per-channel int8 kernels as int8
+    # initializers + explicit Cast/Mul dequantize nodes (the .int8.onnx
+    # route in scripts/export_model.py; loaded by the edge replica
+    # through the same OnnxModel suffix branch)
+    "tictactoe_int8": {"env": "TicTacToe", "_weight_dtype": "int8"},
 }
 
 
@@ -141,12 +146,16 @@ def _export_case(env_args, tmp_path, tag):
     from handyrl_tpu.models import init_variables
     from handyrl_tpu.models.export import OnnxModel, export_onnx  # noqa: F401
 
+    env_args = dict(env_args)
+    weight_dtype = env_args.pop("_weight_dtype", "float32")
     env = make_env(env_args)
     env.reset()
     module = env.net()
     variables = init_variables(module, env)
-    path = str(tmp_path / f"{tag}.onnx")
-    export_onnx(module, variables, env.observation(env.players()[0]), path)
+    suffix = ".int8.onnx" if weight_dtype == "int8" else ".onnx"
+    path = str(tmp_path / f"{tag}{suffix}")
+    export_onnx(module, variables, env.observation(env.players()[0]), path,
+                weight_dtype=weight_dtype)
     return path
 
 

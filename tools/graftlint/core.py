@@ -233,6 +233,10 @@ class LintConfig:
         # proxy + session cache lookup/store on every stateful infer):
         # a stray host sync is a per-request latency regression
         "handyrl_tpu/fleet/*.py",
+        # the low-precision fast path's dequantize runs INSIDE the jitted
+        # engine apply and the ring sample/forward programs: a host sync
+        # here would serialize every quantized inference and train window
+        "handyrl_tpu/models/quantize.py",
     )
     # functions (bare names) that are drain/teardown/construction paths —
     # host syncs there are the POINT, not a leak
@@ -272,6 +276,10 @@ class LintConfig:
         # the session cache touches the device (re-pin on restore) next
         # to serving engines sharing the same chips: same lock discipline
         "handyrl_tpu/fleet/*.py",
+        # quantized engines dispatch the SAME compiled apply the serving
+        # batchers route through dispatch_serialized; direct dispatches in
+        # the quantize module itself must hold the same lock discipline
+        "handyrl_tpu/models/quantize.py",
     )
     dispatch_wrapper: str = "dispatch_serialized"
 

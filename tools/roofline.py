@@ -87,7 +87,8 @@ def stage(env_name: str, overrides: dict, measured_mfu_key: str):
     mesh = make_mesh(args["mesh"])
     ctx = TrainContext(module, args, mesh)
     state = ctx.init_state(model.variables["params"])
-    db = ctx.put_batch(bench._sample_batch(store, args))
+    host_batch = bench._sample_batch(store, args)
+    db = ctx.put_batch(host_batch)
     flops, nbytes, cost_source = _cost(ctx, state, db)
 
     dev = jax.devices()[0]
@@ -112,6 +113,36 @@ def stage(env_name: str, overrides: dict, measured_mfu_key: str):
         out["mfu_ceiling_at_bw"] = round(min(1.0, ai * bw / peak), 4)
         # equivalently: the fastest possible step time is bytes/bw
         out["min_step_time_us_at_bw"] = round(nbytes / bw * 1e6, 1)
+
+    # bytes-after-quantization column (docs/performance.md §Low-precision):
+    # what the int8 fast path removes from the stage's byte traffic.  The
+    # weight figure is the serving-engine residency shrink (per-channel
+    # int8 codes + fp32 scales vs fp32 kernels); the obs figure is the
+    # batch's observation planes at 1-byte width (the int8 obs/wire
+    # plane).  The *_int8_est roofline keys are an ESTIMATE — cost
+    # analysis of the fp32 program minus the byte savings — not a
+    # compiled int8 program; they bound the AI shift, they don't measure
+    # post-fusion layout.
+    from handyrl_tpu.models.quantize import param_bytes, quantize_params
+
+    wb_fp32 = param_bytes(model.variables["params"])
+    wb_int8 = param_bytes(quantize_params(model.variables["params"]))
+    obs_leaves = jax.tree.leaves(host_batch["observation"])
+    ob_fp32 = sum(int(x.size) * 4 for x in obs_leaves)
+    ob_int8 = sum(int(x.size) for x in obs_leaves)
+    out["weight_bytes_fp32"] = wb_fp32
+    out["weight_bytes_int8"] = wb_int8
+    out["obs_bytes_per_step_fp32"] = ob_fp32
+    out["obs_bytes_per_step_int8"] = ob_int8
+    if nbytes:
+        saved = (wb_fp32 - wb_int8) + (ob_fp32 - ob_int8)
+        nbytes_q = max(nbytes - saved, 1.0)
+        out["bytes_accessed_per_step_int8_est"] = nbytes_q
+        out["arithmetic_intensity_int8_est"] = round(flops / nbytes_q, 3)
+        if peak and bw:
+            out["mfu_ceiling_at_bw_int8_est"] = round(
+                min(1.0, flops / nbytes_q * bw / peak), 4
+            )
     return out
 
 
