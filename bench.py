@@ -2104,6 +2104,116 @@ def _fleet_bench(duration: float):
         out["swap_replicas"] = swap.get("replicas")
         out["swap_dropped"] = err_s
         out["swap_flip_observed"] = models == {1, 2}
+
+        # -- elastic leg (docs/serving.md §Elastic fleet): a request storm
+        # -- scales the fleet up WITHOUT shedding (warm-then-admit), then
+        # -- calm scales it back down through the zero-loss migration path
+        import shutil as _shutil
+        import tempfile as _tempfile
+
+        from handyrl_tpu.config import normalize_args as _normalize
+        from handyrl_tpu.fleet import FleetRouter as _FleetRouter
+        from handyrl_tpu.fleet.autoscale import ProcessReplicaFactory
+
+        el_dir = _tempfile.mkdtemp(prefix="bench_fleet_elastic_")
+        el_args = _normalize({
+            "env_args": {"env": "Geister"},
+            "train_args": {
+                "model_dir": el_dir,
+                # max_batch 1 keeps queue depth visible to the autoscaler's
+                # polls, so the storm reliably crosses depth_high
+                "serving": dict(replica_cfg, max_batch=1, max_wait_ms=0.0,
+                                warm_buckets=[1]),
+            },
+        })
+        el_factory = ProcessReplicaFactory(el_args, spawn_timeout_s=600.0)
+        el_fleet = _FleetRouter(
+            {
+                "port": 0, "replicas": [], "stats_poll_s": 0.1,
+                "replica_stall_s": 60.0, "rejoin_backoff_s": 0.5,
+                "rejoin_backoff_max_s": 5.0, "stats_interval": 0.0,
+                "autoscale": {
+                    "enabled": True, "min_replicas": 1, "max_replicas": 2,
+                    "interval_s": 0.1, "shed_slo": 0.01, "depth_high": 2.0,
+                    "depth_low": 1.0, "scale_down_after_s": 1.0,
+                    "cooldown_s": 0.5, "warm_timeout_s": 600.0,
+                },
+            },
+            replica_factory=el_factory,
+        ).run(connect_timeout=600.0)
+        stop_storm = _threading.Event()
+        storm_errors = []
+        storm_ok = [0]
+
+        def _storm():
+            c = ServingClient("127.0.0.1", el_fleet.bound_port)
+            try:
+                while not stop_storm.is_set():
+                    try:
+                        c.infer(obs, timeout=300)
+                        storm_ok[0] += 1
+                    except Exception as exc:
+                        storm_errors.append(repr(exc))
+                        return
+            finally:
+                c.close()
+
+        try:
+            storm_threads = [
+                _threading.Thread(target=_storm, daemon=True)
+                for _ in range(8)
+            ]
+            for t in storm_threads:
+                t.start()
+            deadline = time.monotonic() + 600.0
+            while time.monotonic() < deadline:
+                warm = sum(1 for r in el_fleet._reps()
+                           if r.alive and r.admitted)
+                if el_fleet.scale_ups >= 1 and warm >= 2:
+                    break
+                time.sleep(0.05)
+            stop_storm.set()
+            for t in storm_threads:
+                t.join(timeout=300)
+            admin = ServingClient("127.0.0.1", el_fleet.bound_port)
+            try:
+                stats = admin.stats()
+                shed = sum(r.get("serve_shed") or 0
+                           for r in stats["replicas"].values())
+                # a session pinned to the newest spawned replica — the
+                # calm scale-down must MIGRATE it, not lose it
+                victim = [r for r in el_fleet._reps() if r.spawned][-1]
+                sid = None
+                for _ in range(8):
+                    s = admin.open_session()
+                    if el_fleet._affinity[s] is victim:
+                        sid = s
+                        break
+                if sid is not None:
+                    admin.infer(obs, sid=sid, timeout=300)
+                deadline = time.monotonic() + 600.0
+                while (el_fleet.scale_downs < 1
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+                migrated_ok = (
+                    sid is not None
+                    and admin.infer(obs, sid=sid, timeout=300) is not None
+                )
+            finally:
+                admin.close()
+            out["elastic_scale_ups"] = el_fleet.scale_ups
+            out["elastic_scale_downs"] = el_fleet.scale_downs
+            out["elastic_storm_requests"] = storm_ok[0]
+            out["elastic_storm_errors"] = len(storm_errors)
+            out["elastic_scaleup_shed"] = shed
+            out["elastic_sessions_migrated"] = el_fleet.sessions_migrated
+            out["elastic_handoff_ms"] = round(el_fleet.last_migration_ms, 2)
+            out["elastic_migrated_session_ok"] = migrated_ok
+        finally:
+            stop_storm.set()
+            el_fleet.shutdown()
+            el_factory.close()
+            _shutil.rmtree(el_dir, ignore_errors=True)
     finally:
         for proc, parent in procs:
             try:
@@ -2934,6 +3044,38 @@ def main() -> None:
             "session_bytes_per_req"
         ]
         result["extra"]["fleet_ship_bytes_per_req"] = fl["ship_bytes_per_req"]
+        # elastic leg (docs/serving.md §Elastic fleet): shed-free scale-up
+        # under the storm, zero-loss scale-down migration, handoff wall ms
+        result["extra"]["fleet_elastic_scale_ups"] = fl["elastic_scale_ups"]
+        result["extra"]["fleet_elastic_scale_downs"] = fl[
+            "elastic_scale_downs"
+        ]
+        result["extra"]["fleet_elastic_storm_requests"] = fl[
+            "elastic_storm_requests"
+        ]
+        result["extra"]["fleet_elastic_storm_errors"] = fl[
+            "elastic_storm_errors"
+        ]
+        result["extra"]["fleet_elastic_scaleup_shed"] = fl[
+            "elastic_scaleup_shed"
+        ]
+        result["extra"]["fleet_elastic_sessions_migrated"] = fl[
+            "elastic_sessions_migrated"
+        ]
+        result["extra"]["fleet_elastic_handoff_ms"] = fl["elastic_handoff_ms"]
+        result["extra"]["fleet_elastic_migrated_session_ok"] = fl[
+            "elastic_migrated_session_ok"
+        ]
+        if fl["elastic_storm_errors"] or fl["elastic_scaleup_shed"]:
+            result["error"] = (result["error"] or "") + (
+                f" fleet: elastic storm shed/errored "
+                f"({fl['elastic_scaleup_shed']} shed, "
+                f"{fl['elastic_storm_errors']} errors)"
+            )
+        if not fl["elastic_migrated_session_ok"]:
+            result["error"] = (result["error"] or "") + (
+                " fleet: migrated session lost on scale-down"
+            )
         if fl["load_errors"]:
             result["error"] = (result["error"] or "") + (
                 f" fleet: {fl['load_errors']} request failures under load"

@@ -275,6 +275,38 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
         # seconds between fleet_* health records appended to metrics_path
         # (0 = off)
         "stats_interval": 30.0,
+        # planned-retire budget: seal -> drain in-flight -> export the
+        # SessionCache -> import on the successor must finish inside this,
+        # else the retire proceeds lossy (sessions re-open as counted
+        # affinity misses — degraded loudly, never a hang)
+        "migrate_timeout_s": 30.0,
+        # elastic fleet (docs/serving.md §Elastic fleet): replica count
+        # driven by the windowed shed rate / queue depth the balancer
+        # already polls.  Spawned replicas join warm-then-admit (never
+        # routed to before their engine is published and warmed); retires
+        # go through the zero-loss session-migration path
+        "autoscale": {
+            "enabled": False,
+            # replica-count bounds (non-edge replicas; config-registered
+            # replicas are the operator's floor — never auto-retired)
+            "min_replicas": 1,
+            "max_replicas": 4,
+            # seconds between autoscale decisions
+            "interval_s": 1.0,
+            # scale UP when the windowed shed rate exceeds this SLO...
+            "shed_slo": 0.01,
+            # ...or mean queue depth per replica exceeds depth_high;
+            # scale DOWN only once depth falls under depth_low with zero
+            # sheds for scale_down_after_s straight (hysteresis)
+            "depth_high": 64.0,
+            "depth_low": 1.0,
+            "scale_down_after_s": 30.0,
+            # minimum seconds between any two scale actions
+            "cooldown_s": 10.0,
+            # a spawned replica that is not warm (admitted) within this
+            # is marked lost and cycles through the rejoin backoff
+            "warm_timeout_s": 120.0,
+        },
         # CPU edge replica (`main.py --edge`): port, request threads, and
         # the frozen artifact it serves (CLI path argument overrides)
         "edge_port": 9995,
@@ -780,8 +812,46 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         )
     if float(fleet["stats_interval"]) < 0:
         raise ValueError("train_args.fleet.stats_interval must be >= 0 (0 = off)")
+    if float(fleet["migrate_timeout_s"]) <= 0:
+        raise ValueError(
+            "train_args.fleet.migrate_timeout_s must be > 0 (the planned-"
+            "retire drain/export/import budget)"
+        )
     if int(fleet["edge_workers"]) < 1:
         raise ValueError("train_args.fleet.edge_workers must be >= 1")
+    autoscale = fleet["autoscale"]
+    if not isinstance(autoscale["enabled"], bool):
+        raise ValueError(
+            f"train_args.fleet.autoscale.enabled={autoscale['enabled']!r} "
+            "must be a bool"
+        )
+    if int(autoscale["min_replicas"]) < 1:
+        raise ValueError(
+            "train_args.fleet.autoscale.min_replicas must be >= 1 (a fleet "
+            "scaled to zero cannot serve)"
+        )
+    if int(autoscale["max_replicas"]) < int(autoscale["min_replicas"]):
+        raise ValueError(
+            "train_args.fleet.autoscale.max_replicas must be >= min_replicas"
+        )
+    for key in ("interval_s", "warm_timeout_s"):
+        if float(autoscale[key]) <= 0:
+            raise ValueError(f"train_args.fleet.autoscale.{key} must be > 0")
+    if not 0.0 <= float(autoscale["shed_slo"]) <= 1.0:
+        raise ValueError(
+            "train_args.fleet.autoscale.shed_slo must be in [0, 1] (a shed "
+            "RATE: sheds over requests in the window)"
+        )
+    if float(autoscale["depth_low"]) < 0:
+        raise ValueError("train_args.fleet.autoscale.depth_low must be >= 0")
+    if float(autoscale["depth_high"]) <= float(autoscale["depth_low"]):
+        raise ValueError(
+            "train_args.fleet.autoscale.depth_high must be > depth_low "
+            "(the hysteresis band between scale-up and scale-down)"
+        )
+    for key in ("scale_down_after_s", "cooldown_s"):
+        if float(autoscale[key]) < 0:
+            raise ValueError(f"train_args.fleet.autoscale.{key} must be >= 0")
     league = train["league"]
     if league["pfsp_weighting"] not in ("var", "hard", "even"):
         raise ValueError(

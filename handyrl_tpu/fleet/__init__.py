@@ -10,10 +10,14 @@ never the ``serving`` package) is what keeps the cycle open.
 from .sessions import SessionCache
 from .edge import EdgeReplica, edge_main
 from .router_tier import FleetRouter, ReplicaSpec, fleet_main
+from .autoscale import AutoscaleDecider, Autoscaler, ProcessReplicaFactory
 
 __all__ = [
+    "AutoscaleDecider",
+    "Autoscaler",
     "EdgeReplica",
     "FleetRouter",
+    "ProcessReplicaFactory",
     "ReplicaSpec",
     "SessionCache",
     "edge_main",

@@ -30,6 +30,33 @@ the wire:
 * capabilities: replicas registered with the ``edge`` tag (the ONNX CPU
   backend, fleet/edge.py) receive only feed-forward traffic — stateful
   routes (sessions / wire hidden) and swap propagation skip them.
+
+Elastic fleet (docs/serving.md §Elastic fleet):
+
+* warm-then-admit: a replica is connected the moment it answers TCP but
+  receives NO traffic until its warm probe passes (``serve_models`` >= 1
+  — the engine published and warmed its buckets).  A scaling-up fleet
+  therefore never sheds a request into a cold engine's compile pause;
+* autoscaling: ``fleet.autoscale.*`` arms an `Autoscaler`
+  (fleet/autoscale.py) fed by the same windowed shed-rate/queue-depth
+  records the balancer polls — spawn on load swings via a
+  ``ReplicaFactory``, retire through the migration path below, with
+  hysteresis and min/max bounds;
+* zero-loss retire: a planned retire SEALS the replica (no new picks),
+  parks incoming session infers, drains its in-flight requests, pulls
+  its whole `SessionCache` over the wire (``export_sessions``), lands it
+  in the successor's spill ring (``import_sessions`` — restored
+  bit-identically through the counted ``session_restored`` path), flips
+  affinity, and replays the parked infers on the successor.  The miss
+  counter does not move;
+* preemption: a SIGTERM'd replica broadcasts a ``draining`` notice
+  (serving/server.py ``begin_drain``); the client delivers it through
+  ``on_notice`` and the router runs the same migration inside the
+  replica's ``drain_deadline_seconds``, then lets the process exit 75;
+* bounded failover retry: when a replica is lost mid-request, in-flight
+  STATELESS (no-sid) requests are retried once on a survivor after a
+  short backoff; stateful requests keep the loud ``replica_lost`` error
+  — at-most-once is the session contract, the router must not guess.
 """
 
 from __future__ import annotations
@@ -56,6 +83,16 @@ __all__ = ["FleetRouter", "ReplicaSpec", "fleet_main"]
 # replicas: one shed in the last window outweighs ~100 queued requests,
 # because shedding proves the replica is ALREADY past its SLO capacity
 _SHED_WEIGHT = 100.0
+
+# stateless failover retry: the short pause before re-submitting a
+# replica_lost request on a survivor (lets the loss bookkeeping settle;
+# a zero-delay retry tends to land on the same dying replica's scores)
+_RETRY_BACKOFF_S = 0.05
+
+# session infers parked during their owner's migration window; beyond
+# this the router degrades loudly to a re-route instead of buffering
+# without bound (the parked window is tens of ms, not a second tier)
+_PARK_BOUND = 1024
 
 
 class ReplicaSpec:
@@ -89,6 +126,19 @@ class _Replica:
         self.spec = spec
         self.client: Optional[ServingClient] = None
         self.alive = False
+        # warm-then-admit: connected but admitted=False replicas receive
+        # no traffic until the warm probe sees a published, warmed engine
+        self.admitted = False
+        # sealed: excluded from every new pick (retiring / draining)
+        self.sealed = False
+        # migrating: session infers for sids this replica owns are parked
+        # (under the router's affinity lock) until affinity flips to the
+        # successor — the ordering guarantee bit-identical migration needs
+        self.migrating = False
+        # spawned by the autoscaler's ReplicaFactory (retire stops the
+        # process too); config-registered replicas are the operator's
+        self.spawned = False
+        self.parked: List = []
         self.load = 0.0
         self.picked = 0  # tie-break: spread equal-load picks round-robin
         self._last_stats: Dict[str, Any] = {}
@@ -119,6 +169,7 @@ class FleetRouter(QueueCommunicator):
         self,
         fleet_cfg: Dict[str, Any],
         metrics_path: Optional[str] = None,
+        replica_factory=None,
     ):
         cfg = dict(fleet_cfg or {})
         super().__init__(
@@ -134,12 +185,20 @@ class FleetRouter(QueueCommunicator):
         self.backoff_s = float(cfg.get("rejoin_backoff_s", 1.0))
         self.backoff_max_s = float(cfg.get("rejoin_backoff_max_s", 30.0))
         self.stats_interval = float(cfg.get("stats_interval", 30.0))
+        self.migrate_timeout_s = float(cfg.get("migrate_timeout_s", 30.0))
+        self.autoscale_cfg = dict(cfg.get("autoscale") or {})
+        self._factory = replica_factory
+        self._autoscaler = None
         self._metrics_path = metrics_path
+        self._replicas_lock = threading.Lock()
         self.replicas: List[_Replica] = [
             _Replica(ReplicaSpec.parse(e)) for e in cfg.get("replicas", ())
         ]
-        if not self.replicas:
-            raise ValueError("fleet.replicas is empty — nothing to route to")
+        if not self.replicas and not (
+            self.autoscale_cfg.get("enabled") and replica_factory is not None
+        ):
+            raise ValueError("fleet.replicas is empty — nothing to route to "
+                             "(and no autoscale factory to spawn from)")
         # sid -> replica owning its hidden state.  Entries re-point to a
         # survivor when the owner dies (the new owner then counts an
         # affinity miss and serves the session fresh-state)
@@ -158,6 +217,13 @@ class FleetRouter(QueueCommunicator):
         self.sessions_routed = 0
         self.replicas_lost = 0
         self.hot_swaps = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.migrations = 0
+        self.sessions_migrated = 0
+        self.last_migration_ms = 0.0
+        self.failover_retries = 0
+        self.preempt_drains = 0
         self._stats_t0 = time.monotonic()
         self._stats_served0 = 0
         self._sock = None
@@ -167,8 +233,17 @@ class FleetRouter(QueueCommunicator):
 
     def run(self, connect_timeout: float = 30.0) -> "FleetRouter":
         """Connect the replica fleet (each with retry — replicas may still
-        be booting), then bind the entry port and start serving."""
-        for rep in self.replicas:
+        be booting), warm-probe it, then bind the entry port and start
+        serving.  With autoscaling armed, spawn up to ``min_replicas``
+        from the factory first."""
+        if self.autoscale_cfg.get("enabled") and self._factory is not None:
+            want = int(self.autoscale_cfg.get("min_replicas", 1))
+            have = sum(1 for r in self._reps() if not r.is_edge)
+            for _ in range(max(0, want - have)):
+                self._spawn_replica()
+        for rep in self._reps():
+            if rep.alive:
+                continue  # already connected by _spawn_replica
             try:
                 self._connect(rep, retry_seconds=connect_timeout)
             except OSError as exc:
@@ -177,8 +252,25 @@ class FleetRouter(QueueCommunicator):
                 print(f"fleet: replica {rep.spec.name} unreachable at start "
                       f"({exc}); rejoining in background")
                 self._mark_lost(rep)
-        if not any(r.alive for r in self.replicas):
+                continue
+            threading.Thread(
+                target=self._admit_loop, args=(rep,), daemon=True,
+                name=f"fleet-admit-{rep.spec.name}",
+            ).start()
+        if not any(r.alive for r in self._reps()):
             raise ConnectionError("fleet: no replica reachable at startup")
+        # warm-then-admit gate: serve only once at least one replica has a
+        # published, warmed engine — binding earlier would shed the very
+        # first requests into cold engines, the exact failure this removes
+        deadline = time.monotonic() + connect_timeout
+        while (not any(r.admitted for r in self._reps())
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        if not any(r.admitted for r in self._reps()):
+            raise ConnectionError(
+                "fleet: no replica became warm (admitted) within "
+                f"{connect_timeout:.0f}s — is a model published?"
+            )
         self._sock = open_socket_connection(self.port)
         self._sock.listen(1024)
         self.bound_port = self._sock.getsockname()[1]
@@ -189,17 +281,23 @@ class FleetRouter(QueueCommunicator):
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
+        if self.autoscale_cfg.get("enabled") and self._factory is not None:
+            from .autoscale import Autoscaler
+
+            self._autoscaler = Autoscaler(self, self.autoscale_cfg).start()
         return self
 
     def shutdown(self) -> None:
         super().shutdown()
+        if self._autoscaler is not None:
+            self._autoscaler.stop()
         if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
         self._ctl_pool.shutdown(wait=False)
-        for rep in self.replicas:
+        for rep in self._reps():
             with rep.lock:
                 client, rep.client, rep.alive = rep.client, None, False
             if client is not None:
@@ -215,6 +313,12 @@ class FleetRouter(QueueCommunicator):
 
     # -- replica fleet ------------------------------------------------------
 
+    def _reps(self) -> List[_Replica]:
+        """Snapshot of the (autoscaler-mutable) replica list — every
+        iteration goes through here so list churn never races a loop."""
+        with self._replicas_lock:
+            return list(self.replicas)
+
     def _connect(self, rep: _Replica, retry_seconds: float = 0.0) -> None:
         client = ServingClient(
             rep.spec.host, rep.spec.port,
@@ -222,11 +326,54 @@ class FleetRouter(QueueCommunicator):
             # the stall deadline turns a silent replica into a named
             # failure on every pending proxied request — bounded failover
             stall_timeout=self.replica_stall_s or None,
+            # rid-less server pushes (the preemption "draining" notice)
+            # land here off the client's receiver thread: hand off only
+            on_notice=lambda kind, data, r=rep: self._on_replica_notice(
+                r, kind, data
+            ),
         )
         with rep.lock:
             rep.client = client
             rep.alive = True
+            # a (re)connected replica re-earns admission via the warm
+            # probe — a relaunched preempted process comes back cold
+            rep.admitted = False
+            rep.sealed = False
+            rep.migrating = False
+            rep.parked = []
             rep.load = 0.0
+
+    def _admit_loop(self, rep: _Replica) -> None:
+        """Warm-then-admit probe: poll the replica's stats until its
+        engine is published and warm (``serve_models`` >= 1; an edge
+        artifact is warm by construction the moment stats answer), then
+        open it to traffic.  Bounded by ``autoscale.warm_timeout_s`` —
+        a replica that never warms is marked lost (loudly) and cycles
+        through the rejoin backoff instead of squatting forever."""
+        warm_timeout = float(self.autoscale_cfg.get("warm_timeout_s", 120.0))
+        deadline = time.monotonic() + warm_timeout
+        poll = max(0.05, min(self.stats_poll_s, 0.5))
+        while not self.shutdown_flag and rep.alive and not rep.sealed:
+            client = rep.client
+            if client is None:
+                return
+            try:
+                stats = client.stats(timeout=max(self.stats_poll_s * 4, 10.0))
+            except Exception:
+                self._mark_lost(rep)
+                return
+            stats = stats or {}
+            if rep.is_edge or float(stats.get("serve_models") or 0) >= 1:
+                rep.load = rep.score_from(stats)
+                rep.admitted = True
+                print(f"fleet: replica {rep.spec.name} admitted (warm)")
+                return
+            if time.monotonic() > deadline:
+                print(f"fleet: replica {rep.spec.name} never became warm "
+                      f"within {warm_timeout:.0f}s — marking lost")
+                self._mark_lost(rep)
+                return
+            time.sleep(poll)
 
     def _mark_lost(self, rep: _Replica) -> None:
         """Reap a dead replica: fail-fast state, count the loss, schedule
@@ -262,7 +409,11 @@ class FleetRouter(QueueCommunicator):
                     return
                 try:
                     self._connect(rep)
-                    print(f"fleet: replica {rep.spec.name} rejoined")
+                    print(f"fleet: replica {rep.spec.name} rejoined "
+                          "(warming before re-admission)")
+                    # already on a background thread: probe inline — the
+                    # rejoined replica re-enters rotation only once warm
+                    self._admit_loop(rep)
                     return
                 except OSError:
                     backoff = min(backoff * 2.0, self.backoff_max_s)
@@ -272,8 +423,9 @@ class FleetRouter(QueueCommunicator):
 
     def _live(self, stateful: bool) -> List[_Replica]:
         return [
-            r for r in self.replicas
-            if r.alive and not (stateful and r.is_edge)
+            r for r in self._reps()
+            if r.alive and r.admitted and not r.sealed
+            and not (stateful and r.is_edge)
         ]
 
     def _pick(self, stateful: bool) -> Optional[_Replica]:
@@ -297,8 +449,8 @@ class FleetRouter(QueueCommunicator):
             time.sleep(self.stats_poll_s)
             if self.shutdown_flag:
                 return
-            for rep in self.replicas:
-                if rep.alive:
+            for rep in self._reps():
+                if rep.alive and not rep.sealed:
                     self._ctl_pool.submit(self._poll_one, rep)
 
     def _poll_one(self, rep: _Replica) -> None:
@@ -357,10 +509,19 @@ class FleetRouter(QueueCommunicator):
         stateful = sid is not None or data.get("hidden") is not None
         rep = None
         if sid is not None:
+            # affinity read + migration park are ONE atomic step: a
+            # migrating owner's session infers park under the lock the
+            # retire path flips affinity under, so no request can slip
+            # through to the old owner after its state was exported
             with self._affinity_lock:
                 rep = self._affinity.get(sid)
-            if rep is not None and not rep.alive:
-                rep = None  # owner died: re-route below
+                if rep is not None and rep.migrating:
+                    if len(rep.parked) < _PARK_BOUND:
+                        rep.parked.append((conn, data))
+                        return
+                    rep = None  # park overflow: degrade loudly, re-route
+            if rep is not None and (not rep.alive or rep.sealed):
+                rep = None  # owner died or is retiring: re-route below
         if rep is None:
             rep = self._pick(stateful)
             if rep is None:
@@ -373,6 +534,12 @@ class FleetRouter(QueueCommunicator):
                 # owner serves fresh-state and counts the affinity miss
                 with self._affinity_lock:
                     self._affinity[sid] = rep
+        self._proxy(conn, rep, data, arrival)
+
+    def _proxy(self, conn: FramedConnection, rep: _Replica,
+               data: Dict[str, Any], arrival: float,
+               retried: bool = False) -> None:
+        rid = data.get("rid")
         client = rep.client
         if client is None:
             self._error(conn, rid, "replica_lost",
@@ -380,17 +547,21 @@ class FleetRouter(QueueCommunicator):
             return
         fut = client.submit(
             data.get("obs"), data.get("model", -1), data.get("hidden"),
-            data.get("slo_ms"), sid=sid,
+            data.get("slo_ms"), sid=data.get("sid"),
         )
         fut.add_done_callback(
-            lambda f, c=conn, r=rid, p=rep, a=arrival: self._relay(c, r, p, f, a)
+            lambda f, c=conn, p=rep, d=data, a=arrival, rt=retried:
+                self._relay(c, p, f, d, a, rt)
         )
 
-    def _relay(self, conn: FramedConnection, rid, rep: _Replica, fut: Future,
-               arrival: float) -> None:
+    def _relay(self, conn: FramedConnection, rep: _Replica, fut: Future,
+               data: Dict[str, Any], arrival: float,
+               retried: bool = False) -> None:
         """Reply callback for a proxied infer: forward the result/error to
         the fronted client under ITS rid; a transport-level failure means
-        the replica itself is gone — loud replica_lost, never a hang."""
+        the replica itself is gone — retry once on a survivor if the
+        request is stateless, loud replica_lost otherwise."""
+        rid = data.get("rid")
         exc = fut.exception()
         trace_event("fleet.proxy", time.monotonic() - arrival, t0=arrival,
                     plane="fleet", ok=exc is None, replica=rep.spec.name)
@@ -410,9 +581,216 @@ class FleetRouter(QueueCommunicator):
             return
         # connection loss or stall deadline: the replica is gone
         self._mark_lost(rep)
+        if data.get("sid") is None and not retried:
+            # stateless in-flight requests are safe to re-run (no server-
+            # side session state moved): one bounded retry on a survivor.
+            # Stateful requests keep the loud error — the session contract
+            # is at-most-once, and the router must not guess whether the
+            # lost replica applied the store before dying
+            with self._stats_lock:
+                self.failover_retries += 1
+            self._ctl_pool.submit(self._retry_stateless, conn, data, arrival)
+            return
         self._error(conn, rid, "replica_lost",
                     f"replica {rep.spec.name} lost mid-request "
                     f"({type(exc).__name__}: {exc})")
+
+    def _retry_stateless(self, conn: FramedConnection, data: Dict[str, Any],
+                         arrival: float) -> None:
+        time.sleep(_RETRY_BACKOFF_S)
+        rep = self._pick(stateful=data.get("hidden") is not None)
+        if rep is None:
+            self._error(conn, data.get("rid"), "replica_lost",
+                        "stateless retry found no live replica")
+            return
+        self._proxy(conn, rep, data, arrival, retried=True)
+
+    # -- elastic fleet: migration / preemption / scaling ---------------------
+
+    def _on_replica_notice(self, rep: _Replica, kind: str,
+                           data: Dict[str, Any]) -> None:
+        """Server-pushed notice from a replica's proxy client (called on
+        that client's receiver thread — hand off, never block).  The
+        ``draining`` notice is a preempting replica asking for its
+        sessions to be rescued inside its drain deadline."""
+        if kind != "draining":
+            return
+        with self._stats_lock:
+            self.preempt_drains += 1
+        print(f"fleet: replica {rep.spec.name} is draining (preempted) — "
+              "migrating its sessions to a survivor")
+        # a dedicated thread, not the ctl pool: the handoff can legally
+        # take up to migrate_timeout_s, and the pool is the proxy path
+        threading.Thread(
+            target=self._retire_replica, args=(rep,),
+            kwargs={"reason": "preempted", "remove": False}, daemon=True,
+            name=f"fleet-drain-{rep.spec.name}",
+        ).start()
+
+    def retire(self, rep: _Replica) -> int:
+        """Planned retire (operator/scale-down): seal → drain → migrate
+        sessions to a successor → stop.  Returns sessions migrated."""
+        return self._retire_replica(rep, reason="retire", remove=True)
+
+    def _retire_replica(self, rep: _Replica, reason: str = "retire",
+                        remove: bool = True) -> int:
+        """The zero-loss retire sequence.  Ordering is the whole story:
+
+        1. seal + mark migrating (atomically with the affinity map) — no
+           new picks, session infers for its sids PARK;
+        2. drain its in-flight proxied requests (their session stores
+           land server-side before the reply frame, so the export below
+           sees every applied step);
+        3. export its whole SessionCache over the wire and land it in
+           the successor's spill ring;
+        4. flip affinity to the successor and release the parked infers
+           (served from migrated state via the session_restored path —
+           bit-identical, session_affinity_miss does not move);
+        5. drop the replica (scale-down: stop the spawned process too;
+           preemption: keep the slot, the rejoin loop chases a relaunch).
+        """
+        t_start = time.monotonic()
+        with self._affinity_lock:
+            if rep.sealed:
+                return 0  # already retiring/draining (idempotent)
+            rep.sealed = True
+            rep.migrating = True
+        migrated = 0
+        succ: Optional[_Replica] = None
+        client = rep.client
+        try:
+            if client is not None and rep.alive:
+                deadline = time.monotonic() + self.migrate_timeout_s
+                while (client.pending_count() > 0
+                       and time.monotonic() < deadline):
+                    time.sleep(0.005)
+                exported = client.export_sessions(
+                    timeout=self.migrate_timeout_s
+                )
+                sessions = exported.get("sessions") or {}
+                fresh = exported.get("fresh") or []
+                if sessions or fresh:
+                    succ = self._pick(stateful=True)
+                    if succ is not None and succ.client is not None:
+                        succ.client.import_sessions(
+                            sessions, fresh, timeout=self.migrate_timeout_s
+                        )
+                        migrated = len(sessions)
+                    else:
+                        succ = None
+                        print(f"fleet: retire of {rep.spec.name}: no live "
+                              f"successor for {len(sessions)} session(s) — "
+                              "they will re-open fresh (counted misses)")
+        except Exception as exc:
+            succ = None
+            print(f"fleet: session migration off {rep.spec.name} failed "
+                  f"({type(exc).__name__}: {exc}) — its sessions will "
+                  "re-open fresh (counted misses)")
+        # flip affinity and release the parked infers under the SAME lock
+        # the park decision takes: after this block no request can reach
+        # the exported (now stale) owner
+        with self._affinity_lock:
+            parked, rep.parked = rep.parked, []
+            for s, owner in list(self._affinity.items()):
+                if owner is rep:
+                    if succ is not None:
+                        self._affinity[s] = succ
+                    else:
+                        del self._affinity[s]
+            rep.migrating = False
+        handoff_ms = (time.monotonic() - t_start) * 1000.0
+        with self._stats_lock:
+            self.migrations += 1
+            self.sessions_migrated += migrated
+            self.last_migration_ms = handoff_ms
+        trace_event("fleet.migrate", handoff_ms / 1000.0, t0=t_start,
+                    plane="fleet", sessions=migrated, reason=reason)
+        for pconn, pdata in parked:
+            self._ctl_pool.submit(self._handle_infer, pconn, pdata)
+        print(f"fleet: replica {rep.spec.name} retired ({reason}): "
+              f"{migrated} session(s) migrated"
+              + (f" to {succ.spec.name}" if succ is not None else "")
+              + f" in {handoff_ms:.0f}ms, {len(parked)} parked infer(s) "
+              "replayed")
+        if remove:
+            with self._replicas_lock:
+                try:
+                    self.replicas.remove(rep)
+                except ValueError:
+                    pass
+            with rep.lock:
+                client, rep.client, rep.alive = rep.client, None, False
+            if client is not None:
+                client.close()
+            if rep.spawned and self._factory is not None:
+                try:
+                    self._factory.stop(rep.spec)
+                except Exception as exc:
+                    print(f"fleet: factory stop of {rep.spec.name} failed: "
+                          f"{type(exc).__name__}: {exc}")
+        else:
+            # preempted configured replica: keep its slot and let the
+            # rejoin loop chase the relaunched process (which re-earns
+            # admission through the warm probe)
+            self._mark_lost(rep)
+        return migrated
+
+    def _spawn_replica(self) -> Optional[_Replica]:
+        """Factory-spawn one replica and start warming it.  It joins the
+        rotation only when its admit probe passes — never cold."""
+        if self._factory is None:
+            return None
+        try:
+            spec = self._factory.spawn()
+        except Exception as exc:
+            print(f"fleet: replica spawn failed: {type(exc).__name__}: {exc}")
+            return None
+        rep = _Replica(ReplicaSpec.parse(spec))
+        rep.spawned = True
+        try:
+            self._connect(rep, retry_seconds=10.0)
+        except OSError as exc:
+            print(f"fleet: spawned replica {rep.spec.name} unreachable "
+                  f"({exc}); stopping it")
+            try:
+                self._factory.stop(rep.spec)
+            except Exception:
+                pass
+            return None
+        with self._replicas_lock:
+            self.replicas.append(rep)
+        threading.Thread(
+            target=self._admit_loop, args=(rep,), daemon=True,
+            name=f"fleet-admit-{rep.spec.name}",
+        ).start()
+        return rep
+
+    def scale_up(self, reason: str = "") -> bool:
+        rep = self._spawn_replica()
+        if rep is None:
+            return False
+        with self._stats_lock:
+            self.scale_ups += 1
+        print(f"fleet: scale-up -> {rep.spec.name} (warming; admitted when "
+              f"warm){reason}")
+        return True
+
+    def scale_down(self, reason: str = "") -> bool:
+        """Retire the newest autoscaler-spawned replica through the
+        migration path.  Config-registered replicas are the operator's
+        floor — the autoscaler never retires them."""
+        cands = [
+            r for r in self._reps()
+            if r.spawned and r.alive and not r.sealed
+        ]
+        if not cands:
+            return False
+        rep = cands[-1]
+        with self._stats_lock:
+            self.scale_downs += 1
+        print(f"fleet: scale-down -> retiring {rep.spec.name}{reason}")
+        self._retire_replica(rep, reason="scale-down", remove=True)
+        return True
 
     # -- control frames (pool) ----------------------------------------------
 
@@ -453,7 +831,7 @@ class FleetRouter(QueueCommunicator):
     def _handle_stats(self, conn: FramedConnection, rid) -> None:
         try:
             per_replica = {}
-            for rep in self.replicas:
+            for rep in self._reps():
                 client = rep.client
                 if rep.alive and client is not None:
                     try:
@@ -476,9 +854,10 @@ class FleetRouter(QueueCommunicator):
         warm_ms_total = 0.0
         flipped = 0
         try:
-            for rep in self.replicas:
-                if rep.is_edge or not rep.alive:
-                    continue  # edge artifacts don't take jax params
+            for rep in self._reps():
+                if rep.is_edge or not rep.alive or rep.sealed:
+                    continue  # edge artifacts don't take jax params; a
+                    # retiring replica's engine dies with it anyway
                 client = rep.client
                 if client is None:
                     continue
@@ -520,21 +899,39 @@ class FleetRouter(QueueCommunicator):
             sessions = self.sessions_routed
             lost = self.replicas_lost
             swaps = self.hot_swaps
+            scale_ups = self.scale_ups
+            scale_downs = self.scale_downs
+            migrations = self.migrations
+            migrated = self.sessions_migrated
+            migration_ms = self.last_migration_ms
+            retries = self.failover_retries
+            preempts = self.preempt_drains
             dt = max(now - self._stats_t0, 1e-6)
             served_delta = replies - self._stats_served0
             if advance_window:
                 self._stats_t0 = now
                 self._stats_served0 = replies
+        reps = self._reps()
         record: Dict[str, Any] = {
             "fleet_requests": requests_in,
             "fleet_replies": replies,
             "fleet_errors": errors,
             "fleet_qps": round(served_delta / dt, 2),
-            "fleet_replicas": len(self.replicas),
-            "fleet_replicas_live": sum(1 for r in self.replicas if r.alive),
+            "fleet_replicas": len(reps),
+            "fleet_replicas_live": sum(1 for r in reps if r.alive),
+            "fleet_replicas_warming": sum(
+                1 for r in reps if r.alive and not r.admitted
+            ),
             "fleet_replica_lost": lost,
             "fleet_sessions": sessions,
             "fleet_hot_swaps": swaps,
+            "fleet_scale_ups": scale_ups,
+            "fleet_scale_downs": scale_downs,
+            "fleet_migrations": migrations,
+            "fleet_sessions_migrated": migrated,
+            "fleet_migration_ms": round(migration_ms, 2),
+            "fleet_failover_retries": retries,
+            "fleet_preempt_drains": preempts,
         }
         return record
 
@@ -554,19 +951,27 @@ class FleetRouter(QueueCommunicator):
 def fleet_main(args: Dict[str, Any]) -> None:
     """``main.py --fleet``: the front-end tier over a configured replica
     fleet (``fleet.replicas`` — start each backend with ``--serve`` or
-    ``--edge`` first)."""
+    ``--edge`` first).  With ``fleet.autoscale.enabled`` the router also
+    spawns/retires local serving processes against the shed-rate SLO."""
     from ..utils import trace
 
     train = args["train_args"]
     fleet_cfg = train.get("fleet", {})
     if trace.configure(train.get("trace")):
         print(f"fleet: trace spans -> {trace.current_path()}")
+    factory = None
+    if (fleet_cfg.get("autoscale") or {}).get("enabled"):
+        from .autoscale import ProcessReplicaFactory
+
+        factory = ProcessReplicaFactory(args)
+        print("fleet: autoscale armed (local process replicas)")
     router = FleetRouter(
-        fleet_cfg, metrics_path=train.get("metrics_path")
+        fleet_cfg, metrics_path=train.get("metrics_path"),
+        replica_factory=factory,
     ).run()
     specs = ", ".join(
         r.spec.name + ("[edge]" if r.is_edge else "")
-        for r in router.replicas
+        for r in router._reps()
     )
     print(f"fleet: entry port {router.bound_port} over replicas {specs}")
     try:
@@ -576,3 +981,5 @@ def fleet_main(args: Dict[str, Any]) -> None:
         print("fleet: shutting down")
     finally:
         router.shutdown()
+        if factory is not None:
+            factory.close()

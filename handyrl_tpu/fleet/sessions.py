@@ -22,7 +22,15 @@ Residency discipline:
 * a session absent from BOTH tiers (spill overflow, or a request routed
   to a replica that never saw the sid — the front-end re-routes sessions
   off a dead replica) is an *affinity miss*: the cache re-adopts the sid
-  with fresh initial state so the client keeps playing, and counts it.
+  with fresh initial state so the client keeps playing, and counts it —
+  ONE miss per loss event (the re-adopted sid is fresh again, so a
+  pipelined burst on a lost session cannot inflate the counter);
+* planned retires move sessions instead of losing them:
+  ``export_all`` realizes both tiers host-side and clears the cache
+  (ownership transfer — a straggler infer after export is a counted
+  miss, never a silent fork), ``adopt`` lands migrated sessions in the
+  spill tier so their next infer re-uploads through the SAME
+  ``session_restored`` path the spill ring already pins bit-identical.
 
 The cache is transport-free and device-optional (``device=None`` keeps
 everything host-side — the CPU edge replica's mode), so its semantics
@@ -76,6 +84,8 @@ class SessionCache:
         self.restored = 0
         self.affinity_misses = 0
         self.spill_drops = 0
+        self.migrated_in = 0
+        self.migrated_out = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -126,6 +136,11 @@ class SessionCache:
         if spilled is None:
             with self._lock:
                 self.affinity_misses += 1
+                # the sid is re-adopted FRESH: exactly one counted miss
+                # per loss event.  A pipelined second lookup before the
+                # re-adopting store (or the re-opened session's eventual
+                # close) now counts as a fresh open, not another miss
+                self._fresh.add(sid)
             return None, "miss"
         t0 = time.monotonic()
         hidden = self._pin(spilled)
@@ -147,6 +162,11 @@ class SessionCache:
         pinned = self._pin(hidden)
         with self._lock:
             self._fresh.discard(sid)
+            # a stateless-override infer (wire hidden wins over the cache)
+            # can land while an older copy sits in the spill ring: drop the
+            # stale copy so it neither inflates the spilled gauge nor
+            # occupies ring capacity another session then drops for
+            self._spill.pop(sid, None)
             self._resident[sid] = pinned
             self._resident.move_to_end(sid)
             self._evict_over_capacity()
@@ -176,6 +196,63 @@ class SessionCache:
                 self._spill.popitem(last=False)
                 self.spill_drops += 1
 
+    # -- migration (docs/serving.md §Elastic fleet) --------------------------
+
+    def export_all(self) -> Dict[str, Any]:
+        """Realize every session host-side and CLEAR the cache — ownership
+        transfer to a successor replica.  Returns ``{"sessions": {sid:
+        numpy hidden tree}, "fresh": [sid, ...]}``: opened-but-never-
+        stored sids travel too (with no state), so their first infer on
+        the successor stays a fresh start, not a counted miss.  Clearing
+        is the fork guard: a straggler infer landing here after export is
+        a loud affinity miss, never a silently diverging second copy."""
+        with self._lock:
+            resident = list(self._resident.items())
+            spilled = list(self._spill.items())
+            fresh = sorted(self._fresh)
+            self._resident.clear()
+            self._spill.clear()
+            self._fresh.clear()
+            self.migrated_out += len(resident) + len(spilled)
+        sessions: Dict[str, Any] = {}
+        # spill-ring entries first, residents last: the successor's adopt
+        # keeps insertion order, so the hotter tier stays newest in ITS ring
+        for sid, hidden in spilled + resident:
+            sessions[sid] = tree_map(np.asarray, hidden)
+        return {"sessions": sessions, "fresh": fresh}
+
+    def adopt(self, sessions: Dict[str, Any], fresh=()) -> int:
+        """Land migrated sessions from a retiring replica's ``export_all``.
+        State goes to the SPILL tier: the next infer re-uploads it through
+        the counted ``session_restored`` path — the bit-identity mechanism
+        the spill ring already pins — instead of this thread paying device
+        uploads for sessions that may never speak again.  Returns the
+        number of stateful sessions adopted."""
+        t0 = time.monotonic()
+        with self._lock:
+            for sid in fresh:
+                self._fresh.add(sid)
+            for sid, hidden in (sessions or {}).items():
+                self._fresh.discard(sid)
+                if self.spill_capacity > 0:
+                    self._spill[sid] = tree_map(np.asarray, hidden)
+                    self._spill.move_to_end(sid)
+                else:
+                    # no spill ring configured: adopt straight to resident
+                    self._resident[sid] = self._pin(hidden)
+                    self._resident.move_to_end(sid)
+            self.migrated_in += len(sessions or {})
+            # over-capacity imports overflow EXACTLY like local spills:
+            # oldest dropped, counted — a too-small ring is loud, not wedged
+            while len(self._spill) > self.spill_capacity:
+                self._spill.popitem(last=False)
+                self.spill_drops += 1
+            self._evict_over_capacity()
+            n = len(sessions or {})
+        trace_event("session.migrate", time.monotonic() - t0, t0=t0,
+                    plane="fleet", sessions=n)
+        return n
+
     # -- introspection -------------------------------------------------------
 
     def stats(self) -> Dict[str, Any]:
@@ -188,4 +265,7 @@ class SessionCache:
                 "session_evictions": self.evictions,
                 "session_restored": self.restored,
                 "session_affinity_miss": self.affinity_misses,
+                "session_spill_drops": self.spill_drops,
+                "session_migrated_in": self.migrated_in,
+                "session_migrated_out": self.migrated_out,
             }

@@ -28,6 +28,13 @@ Hooks:
 * ``HANDYRL_FAULT_SIGTERM_AT_STEP="N"`` — the trainer delivers SIGTERM
   to its own process once the step counter reaches N (mid-epoch, the
   way a TPU-VM preemption lands), driving the preemption-safe drain.
+* ``HANDYRL_FAULT_SIGTERM_REPLICA="N"`` — a serving replica
+  (serving/server.py) SIGTERMs its own process after its N-th served
+  reply, the way a spot-instance preemption lands mid-storm.  Drives
+  the preemption-aware drain: the replica broadcasts its ``draining``
+  notice, the fleet router migrates its sessions to a survivor inside
+  ``drain_deadline_seconds``, and the process exits 75 (EX_TEMPFAIL) —
+  the replica-preemption e2e in tests/test_fleet_elastic.py.
 * ``HANDYRL_FAULT_KILL_PROCESS_AT_EPOCH="E:R"`` (or bare ``"E"`` = rank
   0) — the jax.distributed process with index R dies hard
   (``os._exit``) the moment its model epoch reaches E, simulating a
@@ -84,6 +91,28 @@ def sigterm_at_step() -> Optional[int]:
     """Absolute SGD step at which the trainer SIGTERMs its own process."""
     raw = _get("HANDYRL_FAULT_SIGTERM_AT_STEP")
     return None if raw is None else int(raw)
+
+
+def sigterm_replica() -> Optional[int]:
+    """Served-reply count at which a serving replica SIGTERMs its own
+    process (the spot-preemption injection), or None.  Malformed values
+    raise immediately — a typo'd injection silently doing nothing would
+    fake a green preemption e2e."""
+    raw = _get("HANDYRL_FAULT_SIGTERM_REPLICA")
+    if raw is None:
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"HANDYRL_FAULT_SIGTERM_REPLICA={raw!r}: expected an int "
+            "reply count"
+        ) from None
+    if n < 1:
+        raise ValueError(
+            f"HANDYRL_FAULT_SIGTERM_REPLICA={raw!r}: reply count must be >= 1"
+        )
+    return n
 
 
 def _epoch_rank(name: str) -> Optional[Tuple[int, int]]:

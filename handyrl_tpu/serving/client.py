@@ -53,13 +53,19 @@ class ServingError(RuntimeError):
 class ServingClient:
     def __init__(self, host: str, port: int, timeout: float = 60.0,
                  retry_seconds: float = 0.0,
-                 stall_timeout: Optional[float] = None):
+                 stall_timeout: Optional[float] = None,
+                 on_notice=None):
         self.conn = connect_socket_connection(
             host, int(port), timeout=timeout, retry_seconds=retry_seconds
         )
         self.stall_timeout = (
             None if not stall_timeout else float(stall_timeout)
         )
+        # server-pushed notice frames (rid-less by design — e.g. the
+        # "draining" broadcast a preempted replica sends every peer):
+        # delivered here instead of the orphan counter.  Called on the
+        # receiver thread, so handlers must only hand off, never block
+        self.on_notice = on_notice
         self._lock = threading.Lock()
         self._pending: Dict[int, Future] = {}
         self._rid = 0
@@ -99,6 +105,17 @@ class ServingClient:
                 self._fail_all(ConnectionResetError("serving connection lost"))
                 return
             if kind == "heartbeat" or kind == "__hb__":
+                continue
+            if kind == "draining":
+                # a preempting server announcing its drain window: a
+                # notice, not a reply — it must reach the hook (the fleet
+                # router's session-handoff trigger) before orphan counting
+                hook = self.on_notice
+                if hook is not None:
+                    try:
+                        hook(kind, data if isinstance(data, dict) else {})
+                    except Exception:
+                        pass  # the receiver thread outlives any bad hook
                 continue
             rid = (data or {}).get("rid") if isinstance(data, dict) else None
             with self._lock:
@@ -193,6 +210,26 @@ class ServingClient:
             # tree (fresh from a train step) converts here, once
             data["params"] = tree_map(np.asarray, params)
         return self._send("swap", data).result(timeout=timeout)
+
+    def export_sessions(self, timeout: float = 60.0) -> Dict[str, Any]:
+        """Pull the server's whole session cache (migration source side):
+        {"sessions": {sid: numpy hidden tree}, "fresh": [...], "count"}.
+        The server CLEARS its cache — ownership transfers to the caller."""
+        return self._send("export_sessions", {}).result(timeout=timeout)
+
+    def import_sessions(self, sessions: Dict[str, Any], fresh=(),
+                        timeout: float = 60.0) -> Dict[str, Any]:
+        """Hand migrated sessions to the successor replica (adopt —
+        they land in its spill tier and restore bit-identically)."""
+        return self._send("import_sessions", {
+            "sessions": sessions or {}, "fresh": list(fresh),
+        }).result(timeout=timeout)
+
+    def pending_count(self) -> int:
+        """Requests in flight on this connection — the migration drain
+        barrier (a retire exports only once this reaches zero)."""
+        with self._lock:
+            return len(self._pending)
 
     def wire_bytes(self) -> Tuple[int, int]:
         """(sent, received) frame bytes on this connection so far."""

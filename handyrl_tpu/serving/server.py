@@ -19,7 +19,20 @@ Wire protocol (codec frames, all request/reply pairs carry ``rid``):
     -> ("swap",  {"rid", "id", "params"?})  <- ("swapped", {"rid", "id", "warm_ms"})
     -> ("open_session",  {"rid", "model"?})  <- ("session", {"rid", "sid"})
     -> ("close_session", {"rid", "sid"})     <- ("session_closed", {"rid", "sid", "existed"})
+    -> ("export_sessions", {"rid"})     <- ("sessions_export", {"rid", "sessions", "fresh", "count"})
+    -> ("import_sessions", {"rid", "sessions", "fresh"?})
+                                        <- ("sessions_imported", {"rid", "count"})
     -> ("heartbeat", None)              (liveness only, never replied)
+    <- ("draining", {"deadline_s"})     (rid-less notice, pushed to every peer)
+
+``export_sessions``/``import_sessions`` are the migration frames
+(docs/serving.md §Elastic fleet): a planned retire drains the source
+replica, pulls its whole session cache (both tiers, realized to numpy —
+codec-safe), and lands it in the successor's spill ring, where the next
+infer restores it bit-identically through the ``session_restored`` path.
+A SIGTERM'd replica pushes the ``draining`` notice so the fleet router
+runs that same handoff inside ``drain_deadline_seconds`` before the
+process exits 75 (EX_TEMPFAIL — the training plane's preemption code).
 
 An ``infer`` carrying a ``sid`` reads/writes the session's recurrent
 hidden state server-side (fleet/sessions.py) — the wire carries neither
@@ -111,6 +124,16 @@ class ServingServer(QueueCommunicator):
         self.errors: Dict[str, int] = {}
         self._stats_t0 = time.monotonic()
         self._stats_served0 = 0
+        # preemption drain plumbing: set by begin_drain (SIGTERM path),
+        # released by the router pulling the session cache via
+        # export_sessions — or by the deadline, whichever comes first
+        self._sessions_exported = threading.Event()
+        # HANDYRL_FAULT_SIGTERM_REPLICA="N": self-SIGTERM after N served
+        # replies (runtime/faults.py — parsed here so a spawned replica
+        # inherits the injection through its environment)
+        from ..runtime import faults
+
+        self._fault_sigterm_after = faults.sigterm_replica()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -187,6 +210,14 @@ class ServingServer(QueueCommunicator):
                     self._handle_open_session(conn, rid)
                 elif req == "close_session":
                     self._handle_close_session(conn, rid, data.get("sid"))
+                elif req == "export_sessions":
+                    # realizes every resident hidden to host numpy — a
+                    # device sync by design, so off the dispatch thread
+                    self._cold_pool.submit(self._handle_export_sessions,
+                                           conn, rid)
+                elif req == "import_sessions":
+                    self._cold_pool.submit(self._handle_import_sessions,
+                                           conn, rid, data)
                 else:
                     self._error(conn, rid, "bad_request",
                                 f"unknown request {req!r}")
@@ -242,6 +273,68 @@ class ServingServer(QueueCommunicator):
         existed = self.sessions.close(sid)
         self.send(conn, ("session_closed",
                          {"rid": rid, "sid": sid, "existed": existed}))
+
+    def _handle_export_sessions(self, conn: FramedConnection, rid) -> None:
+        """Migration source side: hand the whole session cache (both
+        tiers + fresh sids) to the caller and clear it — ownership
+        transfer.  A session-less server exports empty rather than
+        erroring: retiring a stateless replica is still a legal retire."""
+        try:
+            if self.sessions is None:
+                exported: Dict[str, Any] = {"sessions": {}, "fresh": []}
+            else:
+                exported = self.sessions.export_all()
+            self.send(conn, ("sessions_export", {
+                "rid": rid,
+                "sessions": exported["sessions"],
+                "fresh": exported["fresh"],
+                "count": len(exported["sessions"]),
+            }))
+            # signalled only AFTER the reply frame is on the wire: a
+            # draining serve_main shuts the socket down the moment this
+            # event fires, and the export must not be cut mid-flight
+            self._sessions_exported.set()
+        except Exception as exc:  # a pool task must never die silently
+            self._error(conn, rid, "error", f"{type(exc).__name__}: {exc}")
+
+    def _handle_import_sessions(self, conn: FramedConnection, rid,
+                                data: Dict[str, Any]) -> None:
+        """Migration successor side: adopt the retiring replica's
+        sessions into the spill tier (restored bit-identically on their
+        next infer through the counted ``session_restored`` path)."""
+        try:
+            if self.sessions is None:
+                self._error(conn, rid, "bad_request",
+                            "session cache disabled "
+                            "(serving.session_capacity: 0)")
+                return
+            n = self.sessions.adopt(
+                data.get("sessions") or {}, data.get("fresh") or ()
+            )
+            self.send(conn, ("sessions_imported", {"rid": rid, "count": n}))
+        except Exception as exc:  # a pool task must never die silently
+            self._error(conn, rid, "error", f"{type(exc).__name__}: {exc}")
+
+    def begin_drain(self, deadline_s: float = 60.0) -> bool:
+        """Preemption handoff (SIGTERM path, docs/fault_tolerance.md):
+        push a rid-less ``draining`` notice to every peer, then wait for
+        a router to pull the session cache via ``export_sessions`` — or
+        for the deadline.  Returns True if the handoff happened.  A
+        server with no peers or no sessions returns immediately: there
+        is nothing to hand off, and the drain must never outwait its
+        own deadline doing nothing."""
+        for conn in self.connections():
+            self.send(conn, ("draining", {"deadline_s": float(deadline_s)}))
+        if self.sessions is None or self.connection_count() == 0:
+            return False
+        stats = self.sessions.stats()
+        if stats["session_resident"] + stats["session_spilled"] == 0:
+            return False
+        deadline = time.monotonic() + max(0.0, float(deadline_s))
+        while time.monotonic() < deadline:
+            if self._sessions_exported.wait(timeout=0.1):
+                return True
+        return self._sessions_exported.is_set()
 
     def _do_infer(self, conn: FramedConnection, data: Dict[str, Any],
                   allow_cold: bool = True) -> None:
@@ -317,6 +410,18 @@ class ServingServer(QueueCommunicator):
         if exc is None:
             with self._stats_lock:
                 self.replies += 1
+                replies = self.replies
+            if self._fault_sigterm_after is not None \
+                    and replies == self._fault_sigterm_after:
+                # fault injection: a spot-instance preemption lands mid-
+                # storm — SIGTERM to our own process; serve_main's handler
+                # drives the draining broadcast -> session handoff -> 75
+                import os
+                import signal
+
+                print(f"serving: FAULT sigterm_replica after {replies} "
+                      "replies — raising SIGTERM")
+                os.kill(os.getpid(), signal.SIGTERM)
             out = fut.result()
             if sid is not None and isinstance(out, dict) and "hidden" in out:
                 # the session's whole point: the next-step state stays
@@ -482,11 +587,38 @@ def serve_main(args: Dict[str, Any]) -> None:
         router, train.get("serving", {}), metrics_path=train.get("metrics_path")
     ).run()
     print(f"serving: listening on port {server.bound_port} "
-          f"(model {router.latest_id()}, dir {model_dir!r})")
+          f"(model {router.latest_id()}, dir {model_dir!r})", flush=True)
+
+    # preemption-aware replica (docs/fault_tolerance.md): SIGTERM — the
+    # spot-instance eviction signal — triggers a bounded drain: broadcast
+    # the draining notice, wait for a fleet router to pull the session
+    # cache (export_sessions) inside drain_deadline_seconds, then exit 75
+    # (EX_TEMPFAIL) so a launcher replaces the replica.  SIGINT (an
+    # operator's Ctrl-C) keeps the immediate shutdown.
+    import signal
+    import sys as _sys
+
+    preempted = threading.Event()
     try:
-        while True:
-            time.sleep(1.0)
+        signal.signal(signal.SIGTERM, lambda *_: preempted.set())
+    except ValueError:
+        pass  # not the main thread (embedded use): no preemption handler
+    try:
+        while not preempted.wait(timeout=1.0):
+            pass
+        deadline_s = float(train.get("drain_deadline_seconds", 60.0))
+        print(f"serving: SIGTERM — draining sessions "
+              f"(deadline {deadline_s:.0f}s)", flush=True)
+        handed_off = server.begin_drain(deadline_s)
+        if handed_off:
+            # the export reply frame is written but the router still has
+            # to READ it — closing with unread inbound frames queued (a
+            # racing stats poll) would RST the socket and cut it off
+            time.sleep(0.25)
+        print(f"serving: drain complete (sessions handed off: {handed_off}); "
+              "exiting 75 for relaunch", flush=True)
+        server.shutdown()
+        _sys.exit(75)
     except KeyboardInterrupt:
         print("serving: shutting down")
-    finally:
         server.shutdown()

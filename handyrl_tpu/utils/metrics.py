@@ -49,15 +49,26 @@ METRIC_KEYS = frozenset({
     # exact keys, like serve_*, so every new session stat is reviewed here
     "session_resident", "session_spilled", "session_opened",
     "session_closed", "session_evictions", "session_restored",
-    "session_affinity_miss",
+    "session_affinity_miss", "session_spill_drops",
+    # migration counters (docs/serving.md §Elastic fleet): sessions this
+    # cache handed to / adopted from another replica on a planned retire
+    # or preemption drain — the zero-loss path's own books
+    "session_migrated_in", "session_migrated_out",
     # fleet front-end (handyrl_tpu/fleet/router_tier.py): the session-
     # affinity router's periodic health records — proxy volume, replica
     # liveness (fleet_replica_lost counts loss EVENTS; fleet_replicas_live
-    # is the current gauge), sessions routed, and orchestrated fleet-wide
+    # is the current gauge, fleet_replicas_warming the connected-but-not-
+    # admitted subset), sessions routed, and orchestrated fleet-wide
     # hot-swaps
     "fleet_requests", "fleet_replies", "fleet_errors", "fleet_qps",
-    "fleet_replicas", "fleet_replicas_live", "fleet_replica_lost",
-    "fleet_sessions", "fleet_hot_swaps",
+    "fleet_replicas", "fleet_replicas_live", "fleet_replicas_warming",
+    "fleet_replica_lost", "fleet_sessions", "fleet_hot_swaps",
+    # elastic fleet: autoscale actions, zero-loss migrations (events /
+    # sessions moved / last handoff wall ms), bounded stateless failover
+    # retries, and preemption drains handled
+    "fleet_scale_ups", "fleet_scale_downs", "fleet_migrations",
+    "fleet_sessions_migrated", "fleet_migration_ms",
+    "fleet_failover_retries", "fleet_preempt_drains",
     # league plane (handyrl_tpu/league): per-epoch population health from
     # LeagueLearner._epoch_hook — exact keys, like serve_*, so every new
     # league stat is reviewed here.  league_matches/forfeits/promotions

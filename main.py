@@ -5,9 +5,12 @@ Modes:
     --train-server / -ts     learner serving remote TCP workers
     --worker / -w            worker machine connecting to a train server
     --serve / -s             standalone inference serving plane
-                             (continuous batching + hot-swap; docs/serving.md)
+                             (continuous batching + hot-swap; docs/serving.md;
+                             SIGTERM drains sessions to the fleet and exits 75)
     --fleet / -f             fleet front-end: session-affinity router over
-                             the replicas in fleet.replicas (docs/serving.md)
+                             the replicas in fleet.replicas (docs/serving.md);
+                             fleet.autoscale.enabled spawns/retires local
+                             replica processes against the shed-rate SLO
     --edge [ARTIFACT]        CPU edge replica serving a frozen export
                              artifact (fleet capability tag: edge)
     --league / -l            population-based league training (PFSP

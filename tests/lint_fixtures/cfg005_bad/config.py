@@ -5,6 +5,8 @@ DEFAULT_TRAIN_ARGS = {
     "undocumented_knob": 1,
     "worker": {"num_parallel": 2},
     "mesh": {"dp": -1},
+    # dotted-nested: enabled is documented, min_replicas is not
+    "fleet": {"autoscale": {"enabled": False, "min_replicas": 1}},
 }
 
 DEFAULT_WORKER_ARGS = {

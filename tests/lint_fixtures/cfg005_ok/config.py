@@ -4,6 +4,9 @@ DEFAULT_TRAIN_ARGS = {
     "gamma": 0.8,
     "worker": {"num_parallel": 2},
     "mesh": {"dp": -1},
+    # second-level nesting: "fleet.autoscale" is itself in cfg005_nested,
+    # so its children are per-knob rows, not one opaque dict
+    "fleet": {"port": 9999, "autoscale": {"enabled": False, "min_replicas": 1}},
 }
 
 DEFAULT_WORKER_ARGS = {

@@ -137,9 +137,13 @@ def test_cfg005_both_directions():
     )
     findings = run_lint(cfg, [], rules=["CFG005"])
     msgs = [f.message for f in _rules(findings, "CFG005")]
-    assert len(msgs) == 2, msgs
+    assert len(msgs) == 3, msgs
     assert any("undocumented_knob" in m and "no docs" in m for m in msgs)
     assert any("stale_row" in m for m in msgs)
+    # dotted-nested section ("fleet.autoscale") flattens to per-knob keys:
+    # the undocumented child surfaces, the documented sibling stays silent
+    assert any("fleet.autoscale.min_replicas" in m for m in msgs)
+    assert not any("fleet.autoscale.enabled" in m for m in msgs)
 
 
 def test_cfg005_clean_with_alias():

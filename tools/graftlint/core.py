@@ -291,6 +291,9 @@ class LintConfig:
     cfg005_nested: Tuple[str, ...] = (
         "worker", "distributed", "eval", "serving", "league", "trace",
         "observability", "fleet",
+        # second-level section: the autoscaler's knobs are documented
+        # per-knob (fleet.autoscale.enabled, ...), not as one opaque dict
+        "fleet.autoscale",
     )
     # documented spellings that are intentionally not defaults (aliases
     # normalized away before validation)

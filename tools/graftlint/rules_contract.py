@@ -52,8 +52,11 @@ def _dict_keys(node: ast.Dict, nested: Sequence[str], prefix: str = "",
             continue
         key = key_node.value
         full = f"{prefix}{key}"
-        if isinstance(value, ast.Dict) and key in nested and not prefix:
-            _dict_keys(value, nested, prefix=f"{key}.", out=out)
+        # a nested entry is named by its FULL dotted path, so second-level
+        # sections ("fleet.autoscale") flatten too when declared; for the
+        # top level full == key, which keeps the original entries working
+        if isinstance(value, ast.Dict) and full in nested:
+            _dict_keys(value, nested, prefix=f"{full}.", out=out)
         else:
             out[full] = key_node.lineno
     return out
