@@ -1288,6 +1288,167 @@ def _split_plane_northstar_bench(train_res, duration: float,
     return out
 
 
+# child for the northstar3mp leg: one rank of a 2-process pod-slice run —
+# 4 virtual CPU devices carved 2 learner (global collective mesh) + 2
+# actor (process-local rollout/rings), the full Learner epoch loop
+_NORTHSTAR3MP_CHILD = r"""
+import json, os, sys
+
+port, hport, pid, nproc, outdir, epochs = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+    sys.argv[5], int(sys.argv[6]),
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from handyrl_tpu.config import normalize_args
+from handyrl_tpu.parallel import init_distributed
+
+dist = {
+    "coordinator_address": f"127.0.0.1:{port}",
+    "num_processes": nproc,
+    "process_id": pid,
+    "initialization_timeout": 180.0,
+    "heartbeat_interval": 1.0,
+    "heartbeat_timeout": 60.0,
+    "collective_timeout": 300.0,
+    "health_port": hport,
+}
+init_distributed(dist)
+train = {
+    "plane": "split",
+    "actor_chips": 2,
+    "param_refresh_updates": 2,
+    # both ranks compile concurrently on shared cores: the default 120s
+    # stall bound would degrade a healthy run split -> fused mid-leg
+    "plane_stall_timeout": 600.0,
+    "mesh": {"dp": -1},
+    "turn_based_training": False,
+    "observation": False,
+    "batch_size": 8,
+    "forward_steps": 4,
+    "burn_in_steps": 0,
+    "device_rollout_games": 8,
+    "device_replay": True,
+    "device_replay_slots": 64,
+    "device_replay_k_steps": 16,
+    "minimum_episodes": 20,
+    "update_episodes": 30,
+    "maximum_episodes": 10 ** 6,
+    "epochs": epochs,
+    "num_batchers": 0,
+    "batch_pipeline": "thread",
+    "eval_rate": 0.0,
+    "worker": {"num_parallel": 1},
+    "model_dir": os.path.join(outdir, f"models_{pid}"),
+    "metrics_path": os.path.join(outdir, f"metrics_{pid}.jsonl"),
+    "distributed": dist,
+}
+args = normalize_args(
+    {"env_args": {"env": "ParallelTicTacToe"}, "train_args": train}
+)
+
+from handyrl_tpu.runtime.learner import Learner
+
+code = Learner(args).run()
+
+from handyrl_tpu.parallel.distributed import shutdown_distributed
+
+shutdown_distributed()
+sys.exit(code)
+"""
+
+
+def _multiprocess_split_plane_bench(epochs: int = 3):
+    """North-star v3, POD-SLICE leg (northstar3mp): the same split-plane
+    loop as northstar3 but across TWO real OS processes under
+    jax.distributed — each rank carves its 4 virtual CPU devices 2+2
+    (global collective learner mesh over DCN + process-local actor plane)
+    and the per-rank shards meet the collective train step through the
+    make_array_from_process_local_data seam.
+
+    Subprocess-based and CPU-forced BY DESIGN: two processes cannot share
+    one accelerator, and this leg measures the pod-slice topology's
+    mechanics (collective stepping under per-rank device planes, cadence
+    agreement, the plane duty/transfer keys) rather than chip throughput
+    — the single-process northstar3 stage owns that number.  The
+    acceptance is concurrency: some coordinator epoch must show BOTH
+    planes' rates nonzero in the same window."""
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    batch_size, forward_steps = 8, 4  # mirrors _NORTHSTAR3MP_CHILD
+    with tempfile.TemporaryDirectory(prefix="ns3mp_") as outdir:
+        port, hport = free_port(), free_port()
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+        _note(f"northstar3mp: spawning 2 learner ranks ({epochs} epochs)")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _NORTHSTAR3MP_CHILD, str(port),
+                 str(hport), str(pid), "2", outdir, str(epochs)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            )
+            for pid in range(2)
+        ]
+        try:
+            outs = [
+                p.communicate(timeout=900)[0].decode(errors="replace")
+                for p in procs
+            ]
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            return {"skipped": "northstar3mp children timed out after 900s"}
+        if any(p.returncode != 0 for p in procs):
+            return {"skipped": "northstar3mp child failed: rc=%s\n%s" % (
+                [p.returncode for p in procs],
+                "".join(o[-2000:] for o in outs),
+            )}
+        records = [
+            json.loads(l)
+            for l in open(os.path.join(outdir, "metrics_0.jsonl"))
+            if l.strip()
+        ]
+    epoch_rows = [r for r in records if "plane_actor_busy_frac" in r]
+    if not epoch_rows:
+        return {"skipped": "no plane_* epoch rows in coordinator metrics"}
+    both = [
+        r for r in epoch_rows
+        if r.get("updates_per_sec", 0) > 0 and r.get("episodes_per_sec", 0) > 0
+    ]
+    best = max(epoch_rows, key=lambda r: r.get("updates_per_sec", 0))
+    return {
+        "processes": 2,
+        "epochs": len(epoch_rows),
+        "updates_per_sec": best.get("updates_per_sec", 0.0),
+        "trained_env_steps_per_sec": (
+            best.get("updates_per_sec", 0.0) * batch_size * forward_steps
+        ),
+        "episodes_per_sec": best.get("episodes_per_sec", 0.0),
+        "actor_busy_frac": max(r["plane_actor_busy_frac"] for r in epoch_rows),
+        "xfer_bytes_per_sec": max(
+            r.get("plane_xfer_bytes_per_sec", 0.0) for r in epoch_rows
+        ),
+        "both_planes_concurrent": bool(both),
+        "dist_processes": records[-1].get("dist_processes"),
+    }
+
+
 def _geister_device_replay_bench(duration: float):
     """Turn-mode device-resident replay (runtime/device_replay.py turn
     mode): Geister's DRC ConvLSTM trained straight from device rings —
@@ -2501,7 +2662,8 @@ def _lowprec_bench(duration: float):
 
 KNOWN_STAGES = (
     "tictactoe", "device-selfplay", "geese-device-selfplay", "geese-gen",
-    "geese-train", "northstar", "northstar2", "northstar3", "northstar4",
+    "geese-train", "northstar", "northstar2", "northstar3", "northstar3mp",
+    "northstar4",
     "geese-bf16", "geister", "geister-device-selfplay", "geister-devreplay",
     "serving", "fleet", "league", "lowprec", "transformer",
     "transformer_long", "flash",
@@ -2603,6 +2765,24 @@ def main() -> None:
         )
         _emit_snapshot(result, final=True)
         return
+
+    # a stage-filtered run REFRESHES its stages' numbers in place: seed
+    # from the existing side file so the skipped stages' banked metrics
+    # survive the rewrite (a BENCH_STAGES=northstar3mp smoke must not
+    # clobber the full capture tests/test_perfgate.py loads).  Run
+    # bookkeeping (stages_skipped, partial) is always THIS run's.
+    if only is not None:
+        try:
+            with open(_snapshot_path()) as f:
+                prev = json.loads(f.readline())
+            for k, v in (prev.get("extra") or {}).items():
+                if k not in ("stages_skipped", "stages_deadline_skipped"):
+                    result["extra"][k] = v
+            if "tictactoe" not in only:
+                result["value"] = prev.get("value")
+                result["vs_baseline"] = prev.get("vs_baseline")
+        except (OSError, ValueError):
+            pass  # no prior snapshot: the filtered run stands alone
 
     done = threading.Event()
 
@@ -2900,6 +3080,39 @@ def main() -> None:
 
     if gt is not None:
         _run_stage(result, "northstar3", stage_northstar3)
+
+    # 3e'. north-star v3 pod-slice leg: the SAME split plane across TWO
+    # OS processes under jax.distributed (subprocess children, CPU-forced
+    # 4+4 virtual devices — measures the pod-slice topology's mechanics,
+    # not chip throughput; no geese-train dependency, the children build
+    # their own ParallelTicTacToe run)
+    def stage_northstar3mp():
+        mp = _multiprocess_split_plane_bench(epochs=2 if QUICK else 3)
+        if "skipped" in mp:
+            result["extra"]["northstar3mp_note"] = mp["skipped"]
+            return
+        result["extra"]["northstar3mp_processes"] = mp["processes"]
+        result["extra"]["northstar3mp_updates_per_sec"] = _sig(
+            mp["updates_per_sec"]
+        )
+        result["extra"]["northstar3mp_trained_env_steps_per_sec"] = _sig(
+            mp["trained_env_steps_per_sec"], 5
+        )
+        result["extra"]["northstar3mp_episodes_per_sec"] = _sig(
+            mp["episodes_per_sec"]
+        )
+        result["extra"]["northstar3mp_actor_busy_frac"] = round(
+            mp["actor_busy_frac"], 4
+        )
+        result["extra"]["northstar3mp_xfer_bytes_per_sec"] = _sig(
+            mp["xfer_bytes_per_sec"]
+        )
+        if not mp["both_planes_concurrent"]:
+            result["error"] = (result["error"] or "") + (
+                " northstar3mp: no epoch with both planes' rates nonzero"
+            )
+
+    _run_stage(result, "northstar3mp", stage_northstar3mp)
 
     # 3f. north-star v4: the host-pipeline scaling curve (shm plane at
     # 1/2/4 batcher processes) + the host-bypass device stage, all fed

@@ -125,6 +125,22 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
         # health plane's TCP port on the coordinator host (0 = derive:
         # coordinator port + 1)
         "health_port": 0,
+        # pod-slice topology (docs/performance.md §Pod-slice topology):
+        # 'learner' processes join the jax.distributed collective and run
+        # the cadenced train loop; 'actor' processes stay OUTSIDE the
+        # collective (their loss must be degradable, not a collective
+        # wedge) and stream rollout records to the learner's plane
+        # gateway over DCN, polling versioned params back
+        "role": "learner",
+        # plane gateway's TCP port on the coordinator host (0 = derive:
+        # health port + 1); carries param publishes + record transfers
+        # for distributed.role: actor processes
+        "plane_port": 0,
+        # dedicated actor-host processes expected to connect to the plane
+        # gateway (0 = no cross-host actor tier; rung-1 per-process device
+        # planes only).  Informational for sizing/metrics — a lost actor
+        # host degrades throughput, it never gates the run
+        "actor_hosts": 0,
     },
     "inference_batch_size": 64,
     "prefetch_batches": 2,
@@ -577,33 +593,78 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
             "port + 1 = 65536, which is not a TCP port — set "
             "distributed.health_port explicitly"
         )
+    # pod-slice topology knobs (docs/performance.md §Pod-slice topology).
+    # The device data plane IS supported multi-process now (per-process
+    # rings/rollout feed the collective train step through the
+    # make_array_from_process_local_data seam, every device dispatch
+    # gated on the coordinator cadence, RNGs rank-decorrelated) — so the
+    # old blanket rejections became the composition checks below: what
+    # must actually hold is that the per-process SHARDS divide evenly
+    if str(dist["role"]) not in ("learner", "actor"):
+        raise ValueError(
+            f"train_args.distributed.role={dist['role']!r} not one of "
+            "('learner', 'actor') — learners join the jax.distributed "
+            "collective; actor hosts stream records to the plane gateway"
+        )
+    if not isinstance(dist["plane_port"], int) or not 0 <= dist["plane_port"] <= 65535:
+        raise ValueError(
+            f"train_args.distributed.plane_port={dist['plane_port']!r} "
+            "must be a TCP port (0 = health port + 1)"
+        )
+    if int(dist["actor_hosts"]) < 0:
+        raise ValueError("train_args.distributed.actor_hosts must be >= 0")
+    if (int(dist["actor_hosts"]) > 0 or str(dist["role"]) == "actor") and not dist[
+        "coordinator_address"
+    ]:
+        raise ValueError(
+            "train_args.distributed.actor_hosts/role: actor need "
+            "distributed.coordinator_address — the plane gateway binds on "
+            "(and actor hosts dial) the coordinator host"
+        )
+    if str(dist["role"]) == "actor" and train["device_rollout_games"] <= 0:
+        raise ValueError(
+            "train_args.distributed.role: actor needs device_rollout_games "
+            "> 0 — a dedicated actor host generates with the on-device "
+            "streaming rollout (host self-play already has the worker tier)"
+        )
+    if (
+        dist["plane_port"] == 0
+        and dist["coordinator_address"] is not None
+        and (int(dist["actor_hosts"]) > 0 or str(dist["role"]) == "actor")
+        and (
+            dist["health_port"]
+            or int(str(dist["coordinator_address"]).rpartition(":")[2]) + 1
+        )
+        >= 65535
+    ):
+        raise ValueError(
+            "train_args.distributed.plane_port derives as health port + 1 "
+            "= 65536, which is not a TCP port — set "
+            "distributed.plane_port explicitly"
+        )
     # the distributed plane only ACTIVATES with a coordinator_address
     # (init_distributed returns 0 without one — num_processes alone may
-    # just be a fleet template), so the per-process-local rejections key
+    # just be a fleet template), so the shard-divisibility checks key
     # on both
     if int(dist["num_processes"]) > 1 and dist["coordinator_address"]:
-        if train["device_replay"]:
+        nprocs = int(dist["num_processes"])
+        if int(train["batch_size"]) % nprocs != 0:
             raise ValueError(
-                "train_args.device_replay is not supported under a multi-"
-                "process jax.distributed run yet (the device rings and the "
-                "sampling RNG are per-process; the collective train step "
-                "needs every process sampling the same global windows) — "
-                "use the host batch pipelines"
+                f"train_args.batch_size={train['batch_size']} must divide "
+                f"evenly across distributed.num_processes={nprocs} — each "
+                "process assembles batch_size/num_processes local rows for "
+                "the collective train step"
             )
-        if train["plane"] == "split":
+        if train["device_rollout_games"] > 0 and (
+            int(train["device_rollout_games"]) % nprocs != 0
+        ):
             raise ValueError(
-                "train_args.plane: split is not supported under a multi-"
-                "process jax.distributed run yet (the actor/learner mesh "
-                "carve is per-process-local) — use plane: fused"
-            )
-        if train["device_rollout_games"] > 0:
-            raise ValueError(
-                "train_args.device_rollout_games > 0 is not supported under "
-                "a multi-process jax.distributed run yet (the sharded device "
-                "rollout dispatches device programs outside the coordinator "
-                "cadence — racing the lockstep collectives — and its "
-                "sampling RNG is not rank-decorrelated, so every process "
-                "would generate identical episodes) — use host self-play"
+                f"train_args.device_rollout_games="
+                f"{train['device_rollout_games']} must divide evenly across "
+                f"distributed.num_processes={nprocs} — each process runs "
+                "device_rollout_games/num_processes lanes on its local "
+                "actor devices (the per-mesh lane divisibility is checked "
+                "at Learner startup where the local device count is known)"
             )
     if train["worker"]["heartbeat_interval"] < 0:
         raise ValueError("train_args.worker.heartbeat_interval must be >= 0 (0 = off)")

@@ -144,11 +144,47 @@ def split_mesh(spec: Optional[Dict[str, int]] = None, actor_chips: int = 1,
     ``{'dp': actor_chips}`` mesh.  With per-device dispatch locks the two
     planes enqueue programs concurrently — self-play and training at full
     duty on their own chips (config: ``plane: split`` + ``actor_chips``).
+
+    Under a multi-process ``jax.distributed`` run (``devices`` left None
+    and ``jax.process_count() > 1``) the carve is per HOST, not per list
+    position: every process contributes its leading ``local - actor_chips``
+    devices to one GLOBAL learner mesh (the collective train step spans
+    hosts over DCN) and keeps its trailing ``actor_chips`` devices as a
+    process-LOCAL actor mesh — the actor plane's rollout/ingest programs
+    are per-process by design (each host generates its own shard of
+    episodes), so they must never be collective across hosts.  ``actor_
+    chips`` therefore means "per host" in a pod-slice run.
     """
-    devices = list(devices if devices is not None else jax.devices())
     actor_chips = int(actor_chips)
     if actor_chips < 1:
         raise ValueError(f"actor_chips must be >= 1, got {actor_chips}")
+    if devices is None and jax.process_count() > 1:
+        local = list(jax.local_devices())
+        if actor_chips >= len(local):
+            raise ValueError(
+                f"plane: split needs at least one learner device PER HOST: "
+                f"actor_chips {actor_chips} of {len(local)} local devices "
+                "leaves none (actor_chips is per host in a multi-process run)"
+            )
+        # group the global list by owning process, preserving jax's order
+        # within each group, so the learner mesh keeps the canonical
+        # device order XLA expects for cross-host collectives
+        by_proc: Dict[int, list] = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, []).append(d)
+        counts = {len(ds) for ds in by_proc.values()}
+        if len(counts) != 1:
+            raise ValueError(
+                f"plane: split needs the same local device count on every "
+                f"host, got {sorted(counts)}"
+            )
+        learner_devs = [
+            d for p in sorted(by_proc) for d in by_proc[p][: len(by_proc[p]) - actor_chips]
+        ]
+        learner = make_mesh(spec, learner_devs)
+        actor = make_mesh({"dp": actor_chips}, local[len(local) - actor_chips:])
+        return learner, actor
+    devices = list(devices if devices is not None else jax.devices())
     if actor_chips >= len(devices):
         raise ValueError(
             f"plane: split needs at least one learner device: actor_chips "

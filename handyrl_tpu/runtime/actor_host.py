@@ -1,0 +1,167 @@
+"""Dedicated actor host: on-device self-play feeding a remote learner.
+
+Pod-slice rung 2 (docs/performance.md §Pod-slice topology).  A process
+launched with ``distributed.role: actor`` runs ONLY the data plane: the
+streaming device rollout over all of its local devices, shipping each
+(K, B, ...) record batch to the learner's plane gateway over DCN and
+polling versioned params back (runtime/plane.py — the health plane's TCP
+framing with byte-counted npz payloads).
+
+Deliberately OUTSIDE ``jax.distributed``: an actor host never joins the
+learner collective, so losing one can never wedge a cross-host train step
+— the learner's gateway logs the disconnect, bumps
+``dist_actor_host_losses``, and the surviving producers absorb the game
+quota (the degradable direction of docs/fault_tolerance.md's matrix).
+The reverse is loud: a dead gateway socket means the learner tier is
+gone, and this process announces the fault and exits 75 (EX_TEMPFAIL) so
+a supervisor relaunches it once the learner is back — the params it
+would generate against are unowned until then.
+
+Rate coupling is structural: one record batch is in flight per host (the
+ship is a blocking request/reply), so a slow learner back-pressures the
+rollout loop without a budget protocol.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+import time
+from typing import Any, Dict
+
+from ..envs import make_env, prepare_env
+from ..models import init_variables
+from ..utils import trace
+
+# same convention as the learner's drain path (runtime/learner.py)
+EXIT_RESUMABLE = 75
+
+
+def actor_host_main(args: Dict[str, Any]) -> None:
+    """Entry point for ``--train`` with ``distributed.role: actor``."""
+    import jax
+
+    from ..parallel.mesh import dispatch_serialized, make_mesh
+    from .device_rollout import build_streaming_fn
+    from .plane import PlaneClient
+
+    train_args = dict(args["train_args"])
+    train_args["env"] = args["env_args"]
+    dist = dict(train_args.get("distributed") or {})
+    seed = int(train_args["seed"])
+    rank = int(dist.get("process_id") or 0)
+
+    if trace.configure(train_args.get("trace"), rank=1000 + rank):
+        print(f"trace: spans -> {trace.current_path()} (actor host {rank})")
+
+    prepare_env(args["env_args"])
+    env = make_env(args["env_args"])
+    module = env.net()
+    vector_env = getattr(env, "vector_env", None)
+    if vector_env is None:
+        raise ValueError(
+            f"distributed.role: actor needs a vector env; "
+            f"{args['env_args'].get('env')} exposes no vector_env()"
+        )
+    venv = vector_env()
+    if not hasattr(venv, "record"):
+        raise ValueError(
+            "distributed.role: actor needs a STREAMING vector env "
+            "(record/reset_done/step hooks); "
+            f"{getattr(venv, '__name__', type(venv).__name__)} lacks them"
+        )
+    # match the learner tier's PER-PROCESS lane count: the gateway ingests
+    # into rings built for device_rollout_games / num_processes lanes
+    # (config.py validated the divisibility), and a mismatched record
+    # batch width must fail loudly at the gateway, not silently reshape
+    games = int(train_args["device_rollout_games"]) // max(
+        1, int(dist.get("num_processes") or 1)
+    )
+    mesh = make_mesh({"dp": -1}, jax.local_devices())
+    if games % mesh.size:
+        raise ValueError(
+            f"device_rollout_games {games} not divisible by this actor "
+            f"host's {mesh.size} local devices (lanes shard over them)"
+        )
+    stream_fn = build_streaming_fn(
+        venv, module, games,
+        int(train_args["device_replay_k_steps"]),
+        mesh=mesh if mesh.size > 1 else None,
+        use_observe_mask=bool(train_args["observation"]),
+    )
+    # identical seed -> identical init params on every process: rollouts
+    # are on-policy-ish from step 0, before the first param poll lands
+    params = init_variables(module, env, seed)["params"]
+
+    client = PlaneClient(dist)
+    version = client.connect(
+        retry_for=float(dist.get("initialization_timeout") or 300.0)
+    )
+    print(
+        f"actor host {rank}: connected to plane gateway "
+        f"(param version {version}); {games} lanes on {mesh.size} devices"
+    )
+
+    stop = threading.Event()
+
+    def _stop_signal(signum, frame):
+        print(
+            f"[handyrl_tpu] actor host {rank}: signal {signum} — draining",
+            file=sys.stderr,
+        )
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _stop_signal)
+    signal.signal(signal.SIGINT, _stop_signal)
+
+    # rank-decorrelated rollout stream, offset past the learner ranks'
+    # seed + 1009*rank family so a co-hosted learner never shares a key
+    key = jax.random.PRNGKey(seed + 0x5EED + 0xAC706 + 1009 * rank)
+    key, k0 = jax.random.split(key)
+    vstate = venv.init(games, k0)
+    hidden = module.initial_state((games, venv.num_players))
+    dispatches = 0
+    try:
+        while not stop.is_set():
+            key, sub = jax.random.split(key)
+            vstate, hidden, records = dispatch_serialized(
+                lambda: stream_fn(params, vstate, hidden, sub), mesh
+            )
+            # graftlint: allow[HS001] reason=the record batch leaves this machine over DCN — host materialization is the transport's input, one D2H per k_steps block
+            host_records = jax.device_get(records)
+            gateway_version = client.ship_records(host_records)
+            if gateway_version is None:
+                break  # clean stop from the gateway
+            dispatches += 1
+            if gateway_version > client.param_version:
+                got = client.poll_params()
+                if got is None:
+                    break
+                new_version, fresh = got
+                if fresh is not None:
+                    params = fresh
+                    print(
+                        f"actor host {rank}: params -> version {new_version}"
+                    )
+    except (ConnectionError, OSError) as e:
+        from ..parallel.health import announce_fault
+
+        announce_fault(
+            f"plane gateway lost after {dispatches} dispatches: {e}",
+            "learner_loss",
+            EXIT_RESUMABLE,
+        )
+        client.close()
+        sys.exit(EXIT_RESUMABLE)
+    finally:
+        # await the in-flight async dispatch; exiting the process with an
+        # XLA execution still running aborts it (see
+        # StreamingDeviceRollout.drain)
+        try:
+            # graftlint: allow[HS001] reason=teardown drain: the loop has exited; awaiting the last in-flight rollout is the point (aborting a live XLA execute at interpreter exit crashes)
+            jax.block_until_ready(vstate)
+        except Exception:
+            pass
+    client.close()
+    print(f"actor host {rank}: finished ({dispatches} dispatches)")

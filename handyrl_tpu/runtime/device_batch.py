@@ -29,9 +29,19 @@ make_batch is pinned by the same key-by-key tests as the streaming path
 (tests/test_device_stage.py).
 
 The shm plane stays the default and the fallback: this pipeline refuses
-multi-process meshes (device sampling would need cross-host batch
-construction) and misconfigured stage modes at construction time, and
-``make_pipeline`` then falls back loudly.
+misconfigured stage modes at construction time, and ``make_pipeline``
+then falls back loudly.
+
+Multi-process (docs/performance.md §Pod-slice topology): each process
+stages its OWN host-born episodes into rings on its LOCAL devices and
+samples ``batch_size / num_processes`` rows per update; the local rows
+hop through host once (one D2H of the sampled windows, not the per-step
+observation re-upload this plane exists to kill) and re-enter the
+collective mesh through ``TrainContext.put_batch`` — jax's
+``make_array_from_process_local_data`` seam — so the cross-host train
+step sees one global batch assembled from per-host rings.  The sampling
+key is rank-decorrelated (fold_in(process_index)) or every process
+would draw the same window indices from different rings.
 """
 
 from __future__ import annotations
@@ -65,12 +75,6 @@ class DeviceBatchPipeline:
                  stop_event: Optional[threading.Event] = None):
         import jax
 
-        if jax.process_count() > 1:
-            raise RuntimeError(
-                "batch_pipeline: device is single-process (device-side "
-                "sampling cannot assemble a cross-host global batch); use "
-                "batch_pipeline: shm under jax.distributed"
-            )
         self.args = args
         self.store = store
         self.ctx = ctx
@@ -79,16 +83,34 @@ class DeviceBatchPipeline:
 
         self._local_batch = local_batch_size(args["batch_size"])
         self._fused = max(1, args.get("fused_steps", 1))
+        # multi-process: rings/stage/sampling live on this process's LOCAL
+        # devices (each host assembles its own shard of the global batch);
+        # the sampled rows cross to the collective ctx.mesh through
+        # put_batch in batch() below.  Single-process: the stage shares
+        # the train mesh and batch() returns device-resident output
+        self._multiproc = jax.process_count() > 1
+        if self._multiproc:
+            from ..parallel.mesh import make_mesh
+
+            self._mesh = make_mesh({"dp": -1}, jax.local_devices())
+        else:
+            self._mesh = ctx.mesh
         # raises on mode misconfiguration (recurrent net without turn
         # windows, missing observation flag, slots too shallow) — caught
         # by make_pipeline, which falls back loudly
         self.stage = DeviceEpisodeStage(
-            ctx.module, args, ctx.mesh,
+            ctx.module, args, self._mesh,
             n_lanes=int(args.get("device_stage_lanes", 8)),
             slots=int(args.get("device_stage_slots", 1024)),
             chunk_steps=int(args.get("device_stage_chunk", 64)),
         )
         self._key = jax.random.PRNGKey(int(args.get("seed", 0)) ^ 0xD17A)
+        if self._multiproc:
+            # rank-decorrelated draws: every process holds DIFFERENT
+            # episodes, and must also draw different window indices (the
+            # seed + 1009*rank pattern, as a key fold); single-process
+            # keys are untouched so the existing parity pins hold
+            self._key = jax.random.fold_in(self._key, jax.process_index())
         self._sampler = None
         self._eligible = False
         self._started = False
@@ -174,7 +196,7 @@ class DeviceBatchPipeline:
         from ..parallel.mesh import dispatch_serialized
 
         replay = self.stage.replay
-        mesh = self.ctx.mesh
+        mesh = self._mesh
         B, fused = self._local_batch, self._fused
         rep = NamedSharding(mesh, PartitionSpec())
         out_shard = (
@@ -254,6 +276,24 @@ class DeviceBatchPipeline:
         self._key, sub = jax.random.split(self._key)
         t0 = time.perf_counter()
         out = self._sampler(sub)
+        if self._multiproc:
+            # the one deliberate host hop of the multi-process path: the
+            # local rows leave the local mesh ONCE (B/nprocs sampled
+            # windows, not the per-step observation re-upload this plane
+            # kills) and re-enter the collective mesh via put_batch's
+            # make_array_from_process_local_data seam, which takes host
+            # buffers by contract
+            # graftlint: allow[HS001] reason=documented local-shard crossing: make_array_from_process_local_data consumes host buffers; one D2H of sampled rows per update, not per step
+            host = jax.device_get(out)
+            if self._fused == 1:
+                out = self.ctx.put_batch(host)
+            else:
+                out = self.ctx.put_batches(
+                    [
+                        jax.tree.map(lambda x, i=i: x[i], host)
+                        for i in range(self._fused)
+                    ]
+                )
         with self._lock:
             self._stats["sample_s"] += time.perf_counter() - t0
             self._stats["batches"] += self._fused

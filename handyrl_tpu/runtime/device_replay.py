@@ -181,6 +181,7 @@ class DeviceReplay:
         self._ingest = None
         self._pending = None     # last dispatched stats (drain target)
         self._train_fns: Dict[int, Any] = {}
+        self._sample_fns: Dict[int, Any] = {}
         self._sample_debug = None
         self.counters = {
             "episodes": 0, "game_steps": 0, "player_steps": 0,
@@ -311,10 +312,19 @@ class DeviceReplay:
             spec = tree_map(lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), records)
             self.rings, _ = self._init_rings(spec)
         if self._ingest is None:
-            rec_sharding = tree_map(
+            self._rec_sharding = tree_map(
                 lambda x: NamedSharding(self.mesh, PartitionSpec(None, "dp")), records
             )
-            self._ingest = self._build_ingest(rec_sharding)
+            self._ingest = self._build_ingest(self._rec_sharding)
+        if jax.process_count() > 1:
+            # multi-process jit refuses numpy args under partitioned
+            # shardings even on a fully-addressable process-local mesh —
+            # place host-born records (the episode-stage flush path)
+            # explicitly; device-born rollout records pass through
+            records = tree_map(
+                lambda x, s: x if isinstance(x, jax.Array) else jax.device_put(x, s),
+                records, self._rec_sharding,
+            )
         from ..parallel.mesh import dispatch_serialized
 
         def _run():
@@ -422,6 +432,46 @@ class DeviceReplay:
         if with_info:
             return batch, tree_map(np.asarray, info[0])
         return batch
+
+    def sample_host(self, key, batch_size: int):
+        """Sample ``batch_size`` windows and materialize them on HOST.
+
+        The multi-process path: each process samples its LOCAL rings for
+        its shard of the global batch, and the host rows re-enter the
+        device world through ``TrainContext.put_batch`` — jax's
+        ``make_array_from_process_local_data`` seam — so the collective
+        train step sees one global batch assembled from per-host episode
+        populations.  The fused ``train_fn`` cannot be used there: it
+        would fuse a process-LOCAL gather into the cross-host collective
+        program, and the rings live on different meshes per process.
+        Jitted per batch size; rings read under the dispatch locks like
+        every other ring consumer (a concurrent ingest donates the old
+        buffers)."""
+        if batch_size not in self._sample_fns:
+            rep = NamedSharding(self.mesh, PartitionSpec())
+
+            def fn(rings, key):
+                return self._sample(rings, key, batch_size)
+
+            holder = {}
+
+            def bound(key):
+                if "fn" not in holder:
+                    ring_shard = _lane_sharding(self.mesh, self.rings)
+                    holder["fn"] = jax.jit(
+                        fn, in_shardings=(ring_shard, rep), out_shardings=rep
+                    )
+                from ..parallel.mesh import dispatch_serialized
+
+                # self.rings is read INSIDE the locked lambda — see ingest
+                return dispatch_serialized(
+                    lambda: holder["fn"](self.rings, key), self.mesh
+                )
+
+            self._sample_fns[batch_size] = bound
+        batch = self._sample_fns[batch_size](key)
+        # graftlint: allow[HS001] reason=the point of this path IS host materialization: local shard rows cross to the collective mesh via make_array_from_process_local_data, which takes host buffers
+        return tree_map(np.asarray, jax.device_get(batch))
 
     def train_fn(self, ctx, fused_steps: int = 1):
         """Jitted ``fn(state, key, lr) -> (state, metrics)`` running

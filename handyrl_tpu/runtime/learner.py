@@ -328,7 +328,15 @@ class Learner:
         # stepping + inference + sampling in one jit call per batch of
         # games; workers then mostly evaluate
         self._device_games = int(self.args.get("device_rollout_games", 0))
+        if self._dist_nprocs > 1 and self._device_games > 0:
+            # pod-slice rung 1: device_rollout_games is the GLOBAL lane
+            # count; each process runs its 1/nprocs share on its LOCAL
+            # devices (divisibility validated in config.py) and the
+            # shards meet in the collective train step via put_batch
+            self._device_games //= self._dist_nprocs
         self._replay = None        # set below in device_replay mode
+        self._data_mesh = None     # local mesh the data plane runs on
+        self._plane_gateway = None  # rung-2 actor-host transport (run())
         # per-epoch device self-play volume -> mean episode length in
         # metrics.jsonl (the survival signal on episode-length envs)
         self._device_epoch_eps = 0
@@ -385,6 +393,21 @@ class Learner:
                     f"hook); {type(self._venv).__name__ if not isinstance(self._venv, type) else self._venv.__name__} "
                     "records acting players only — use host actors instead"
                 )
+            # pod-slice rung 1: under multi-process SPMD the data plane
+            # (rollout lanes, rings, record transfer) is PER PROCESS on
+            # this host's local learner devices — only the train step is
+            # collective, and the local shard it samples enters via
+            # TrainContext.put_batch's make_array_from_process_local_data
+            # seam.  Single-process: the data plane IS the learner mesh.
+            if self._dist_nprocs > 1:
+                local = [
+                    d
+                    for d in self.trainer.ctx.mesh.devices.flat
+                    if d.process_index == jax.process_index()
+                ]
+                self._data_mesh = make_mesh({"dp": -1}, local)
+            else:
+                self._data_mesh = self.trainer.ctx.mesh
             # constructed HERE so misconfiguration (e.g. lane count not
             # divisible by the mesh's dp axis) fails the run at startup
             # instead of silently killing the rollout daemon thread
@@ -396,10 +419,11 @@ class Learner:
                 from .device_replay import DeviceReplay
                 from .device_rollout import build_streaming_fn
 
-                mesh = self.trainer.ctx.mesh
+                mesh = self._data_mesh
                 # rings (and the ingest/train donation contract) live on
-                # the LEARNER mesh; under plane: split the rollout program
-                # runs on the actor mesh and its records cross over
+                # the LEARNER data mesh (this process's learner devices);
+                # under plane: split the rollout program runs on the actor
+                # mesh and its records cross over
                 self._replay = DeviceReplay(
                     self._venv, self.module, self.args, mesh,
                     self._device_games,
@@ -429,19 +453,45 @@ class Learner:
                     self._venv, self.module, self.args, self._device_games,
                     mesh=self._actor_mesh
                     if self._actor_mesh is not None
-                    else self.trainer.ctx.mesh,
+                    else self._data_mesh,
                 )
             if self._actor_mesh is not None:
                 from .plane import PlaneParamCache, PlaneStats
 
                 self._param_cache = PlaneParamCache(self._actor_mesh)
-                # version 0 .. steps: the resumed step count keeps publish
-                # versions monotone across restarts
-                self._param_cache.publish(
-                    self.trainer.state["params"], self.trainer.steps
-                )
                 self._plane_stats = PlaneStats()
                 self.trainer.param_cache = self._param_cache
+            # pod-slice rung 2: the coordinator fronts the cross-host
+            # plane — record batches from distributed.actor_hosts land in
+            # its device rings, versioned params go back over DCN
+            # (runtime/plane.py).  Followers never host it: actor hosts
+            # dial the one coordinator-derived plane port.
+            dist_args = self.args.get("distributed") or {}
+            if int(dist_args.get("actor_hosts") or 0) > 0 and not self._dist_follower:
+                if self._replay is None:
+                    raise ValueError(
+                        "distributed.actor_hosts > 0 needs device_replay: "
+                        "true on the learner tier — actor-host record "
+                        "batches land in the device replay rings "
+                        "(docs/performance.md §Pod-slice topology)"
+                    )
+                from .plane import PlaneGateway
+
+                self._plane_gateway = PlaneGateway(
+                    dist_args,
+                    on_records=self._gateway_on_records,
+                    inner=self._param_cache,
+                )
+                # one publish surface feeds both transports: the gateway
+                # delegates to the local actor-mesh cache when plane:
+                # split is also active on this host
+                self.trainer.param_cache = self._plane_gateway
+            if self.trainer.param_cache is not None:
+                # version 0 .. steps: the resumed step count keeps publish
+                # versions monotone across restarts
+                self.trainer.param_cache.publish(
+                    self.trainer.state["params"], self.trainer.steps
+                )
 
         # on-device evaluation (runtime/device_eval.py): batched
         # net-vs-baseline matches at every epoch boundary — the per-epoch
@@ -658,6 +708,14 @@ class Learner:
             # another boundary
             record["dist_processes"] = self._dist_nprocs
             record.update(self._dist_events())
+        if self._plane_gateway is not None:
+            # cross-host actor tier health: live producer count plus the
+            # cumulative losses (each one a degrade the survivors absorbed)
+            record["dist_actor_hosts"] = int(self._plane_gateway.actor_hosts)
+            record["dist_actor_host_losses"] = int(
+                self._plane_gateway.actor_host_losses
+            )
+        if self._dist_nprocs > 1:
             if self._health is not None and self._rank_metrics:
                 snap = self._rank_snapshot(steps)
                 if self._dist_follower:
@@ -678,19 +736,30 @@ class Learner:
         plane_stats = self._plane_stats
         param_cache = self._param_cache
         record_xfer = self._record_xfer
-        if plane_stats is not None and param_cache is not None:
+        gateway = self._plane_gateway
+        if gateway is not None or (
+            plane_stats is not None and param_cache is not None
+        ):
             # per-epoch plane health (diffed cumulative counters): realized
             # actor-plane duty, mean param staleness at dispatch, and the
-            # cross-mesh transfer rate (records learner-ward + params
-            # actor-ward) — the plane_* keys soaks watch next to pipe_*
-            snap = plane_stats.snapshot()
-            snap["xfer_bytes"] = param_cache.bytes_transferred + (
+            # cross-plane transfer rate (records learner-ward + params
+            # actor-ward) — the plane_* keys soaks watch next to pipe_*.
+            # The gateway's byte count already folds in the local cache
+            # (``inner``), so it substitutes rather than adds.
+            snap = plane_stats.snapshot() if plane_stats is not None else {}
+            cache_bytes = (
+                gateway.bytes_transferred
+                if gateway is not None
+                else param_cache.bytes_transferred
+            )
+            snap["xfer_bytes"] = cache_bytes + (
                 record_xfer.bytes_transferred if record_xfer else 0
             )
             prev, dt = self._plane_stats0, max(now - self._epoch_t0, 1e-6)
-            diff = lambda k: snap[k] - prev.get(k, 0.0)
-            record["plane_actor_busy_frac"] = round(diff("actor_busy_s") / dt, 4)
-            record["plane_actor_idle_frac"] = round(diff("actor_idle_s") / dt, 4)
+            diff = lambda k: snap.get(k, 0.0) - prev.get(k, 0.0)
+            if plane_stats is not None:
+                record["plane_actor_busy_frac"] = round(diff("actor_busy_s") / dt, 4)
+                record["plane_actor_idle_frac"] = round(diff("actor_idle_s") / dt, 4)
             record["plane_xfer_bytes_per_sec"] = round(diff("xfer_bytes") / dt, 1)
             if diff("actor_dispatches"):
                 record["plane_param_lag_mean"] = round(
@@ -912,6 +981,44 @@ class Learner:
             "train_steps_per_sec": stats.get("train_steps_per_sec"),
             "input_wait_frac": stats.get("input_wait_frac"),
         }
+
+    def _gateway_on_records(self, records: Dict[str, Any]) -> None:
+        """Plane-gateway ingest (runs on a gateway serve thread): validate
+        the lane width, ingest into this process's device rings, and book
+        the counters through the same server-loop request the local
+        rollout thread uses.
+
+        ``defer=False`` on purpose: the deferred-stats FIFO belongs to the
+        local rollout thread (``ingest_counted(defer=True)`` pairs each
+        dispatch with a LATER fetch), and a second writer interleaving
+        would misattribute both streams' stats.  One synchronous scalar
+        fetch per record batch is noise next to the DCN payload it rode
+        in on."""
+        import jax
+
+        widths = {x.shape[1] for x in jax.tree.leaves(records)}
+        if widths != {self._device_games}:
+            raise ValueError(
+                f"plane gateway: record batch lane width {sorted(widths)} "
+                f"!= this learner's {self._device_games} per-process lanes "
+                "(device_rollout_games / num_processes must match on both "
+                "tiers)"
+            )
+        stats = self._replay.ingest_counted(records, defer=False)
+        episodes = int(stats["episodes"])
+        if episodes <= 0 and int(stats["game_steps"]) <= 0:
+            return
+        counts = {
+            "episodes": episodes,
+            "players": self._venv.num_players,
+            "model_id": self.model_epoch,
+            "game_steps": int(stats["game_steps"]),
+            "outcome_sum": float(stats["outcome_sum"].sum()),
+            "outcome_sq_sum": float(stats["outcome_sq_sum"]),
+        }
+        # fire-and-forget: the serve thread must keep answering its actor
+        # host; the server loop books the counts when it gets there
+        self._requests.put(("device_counts", counts, Future()))
 
     def _dist_events(self) -> Dict[str, int]:
         """Cumulative cross-host health counters for the dist_* metrics."""
@@ -1289,14 +1396,25 @@ class Learner:
             "cross-plane param/record flows stop)",
             file=sys.stderr,
         )
-        self.trainer.param_cache = None
+        if self._plane_gateway is not None:
+            # the cross-HOST plane outlives a local split->fused degrade:
+            # drop only the actor-mesh delegate, keep publishing to the
+            # gateway so remote actor hosts still get fresh params
+            self._plane_gateway.inner = None
+            self.trainer.param_cache = self._plane_gateway
+        else:
+            self.trainer.param_cache = None
         self._param_cache = None
         self._record_xfer = None
         self._plane_stats = None
         self._actor_mesh = None
         self._plane = "fused"
         self._watchdog_events["plane_watchdog_degraded"] = 1
-        mesh = self.trainer.ctx.mesh
+        mesh = (
+            self._data_mesh
+            if self._data_mesh is not None
+            else self.trainer.ctx.mesh
+        )
         try:
             if self._replay is not None:
                 from .device_rollout import build_streaming_fn
@@ -1335,8 +1453,15 @@ class Learner:
         the loop exits once the watchdog supersedes it."""
         import jax
 
-        # a restarted generation must not replay the superseded stream
-        key = jax.random.PRNGKey(self.args["seed"] + 0x5EED + 0x1009 * (gen - 1))
+        # a restarted generation must not replay the superseded stream;
+        # the 1009 * rank fold decorrelates the per-process lane shares
+        # (each rank generates DIFFERENT games into its local rings)
+        key = jax.random.PRNGKey(
+            self.args["seed"]
+            + 0x5EED
+            + 0x1009 * (gen - 1)
+            + 1009 * self._dist_rank
+        )
         if self._device_roll is None:          # device_replay mode
             try:
                 self._device_replay_inner(key, gen)
@@ -1389,7 +1514,7 @@ class Learner:
 
         split = self._param_cache is not None
         roll_mesh = (
-            self._actor_mesh if split else self.trainer.ctx.mesh
+            self._actor_mesh if split else self._data_mesh
         )
         # entry-captured refs: a concurrent watchdog degrade nulls the
         # attributes, and a late-waking superseded thread must die at its
@@ -1401,6 +1526,26 @@ class Learner:
         hidden = self.module.initial_state(
             (self._device_games, self._venv.num_players)
         )
+        if roll_mesh is not None:
+            # commit every dispatch input onto the rollout mesh UP FRONT:
+            # the loop's args then match the program's pinned in_shardings
+            # exactly, so no dispatch triggers an implicit host->mesh
+            # reshard.  That implicit copy is not just a per-dispatch
+            # transfer on the hot path — under plane: split it races the
+            # async ingest running on the OTHER plane's devices (observed
+            # on the multi-process CPU backend as Execute() placement
+            # errors killing the rollout thread), and committed args keep
+            # every cross-device move explicit and plane-owned.  The key
+            # stays mesh-resident too: split() of a committed key runs on
+            # the actor mesh and its outputs inherit the placement.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(roll_mesh, PartitionSpec())
+            lanes = NamedSharding(roll_mesh, PartitionSpec("dp"))
+            key = jax.device_put(key, rep)
+            vstate = jax.device_put(vstate, lanes)
+            if hidden is not None:
+                hidden = jax.device_put(hidden, lanes)
         from collections import deque
 
         pending_steps = 0   # game steps from batches that finished 0 episodes
@@ -1522,6 +1667,13 @@ class Learner:
     def _device_rollout_inner(self, roll, key, gen: int) -> None:
         import jax
 
+        roll_mesh = getattr(roll, "mesh", None)
+        if roll_mesh is not None:
+            # mesh-resident key, same contract as _device_replay_inner:
+            # dispatch args never ride an implicit host->mesh reshard
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            key = jax.device_put(key, NamedSharding(roll_mesh, PartitionSpec()))
         dispatches = 0
         while self._rollout_live(gen):
             if self.num_returned_episodes >= self._next_update_episodes:
@@ -1573,6 +1725,8 @@ class Learner:
                 self._health.start()
             if self._collective_watchdog is not None:
                 self._collective_watchdog.start()
+            if self._plane_gateway is not None:
+                self._plane_gateway.start()
             self._trainer_thread = threading.Thread(target=self.trainer.run, daemon=True)
             self._trainer_thread.start()
             self.worker.run()
@@ -1583,6 +1737,10 @@ class Learner:
                     target=self._watchdog_loop, daemon=True, name="plane-watchdog"
                 ).start()
             self.server()
+            if self._plane_gateway is not None:
+                # run concluding: answer every further actor-host request
+                # with a clean stop (they exit 0, not as counted losses)
+                self._plane_gateway.begin_stop()
             if self._rollout_thread is not None:
                 # let an in-flight device call drain: tearing down the
                 # interpreter while a daemon thread is inside an XLA execute
@@ -1598,6 +1756,8 @@ class Learner:
                 self._health.stop()
             if self._collective_watchdog is not None:
                 self._collective_watchdog.stop()
+            if self._plane_gateway is not None:
+                self._plane_gateway.stop()
             self._restore_signal_handlers()
             trace.shutdown()  # flush the span ring tail; a no-op when off
         return EXIT_RESUMABLE if self._drain_requested else 0

@@ -761,12 +761,14 @@ class Trainer:
         warmup_wait_s = 0.0
         t_epoch = time.perf_counter()
         fused = self.fused
-        if self.device_replay is not None:
+        replay_train = None
+        last_batch = None
+        if self.device_replay is not None and self.cadence is None:
             # all-on-device SGD: sample + assemble + step in one dispatch.
             # One-deep pipelining (block on update N-1 before dispatching
             # N+1) keeps the dispatch queue shallow so the concurrent
             # rollout thread gets device time at every boundary.
-            train = self.device_replay.train_fn(self.ctx, fused)
+            replay_train = train = self.device_replay.train_fn(self.ctx, fused)
             on_cpu = jax.default_backend() == "cpu"
             while data_cnt == 0 or not self.update_flag:
                 if self.stop_event.is_set():
@@ -794,10 +796,87 @@ class Trainer:
                     # real sleep hands the lock to the waiting producer;
                     # on TPU dispatch is async and the gap never forms.
                     time.sleep(0.02)
+        elif self.device_replay is not None:
+            # pod-slice rung 1 (docs/performance.md §Pod-slice topology):
+            # per-process rings under the coordinator cadence.  The fused
+            # all-on-device path above cannot run here — it would fuse a
+            # process-LOCAL ring gather into the cross-host collective
+            # program (the rings live on different local meshes per
+            # process).  Instead each agreed iteration samples this
+            # process's B/nprocs shard to host (one D2H of sampled rows)
+            # and re-enters the collective mesh through put_batch's
+            # make_array_from_process_local_data seam — so every device
+            # dispatch (local sample AND collective step) happens inside
+            # the agreed cadence window, never racing the lockstep
+            # collectives.  The local sample holds a SUBSET of the global
+            # step's device locks, so the per-device dispatch order stays
+            # consistent across ranks.
+            from ..parallel import local_batch_size
+            from ..parallel.distributed import CMD_END
+
+            B_local = local_batch_size(self.args["batch_size"])
+            on_cpu = jax.default_backend() == "cpu"
+            while True:
+                # coordinator-broadcast epoch end: every process runs the
+                # SAME step count, or the next collective wedges
+                if self._agree_step(data_cnt > 0) & CMD_END:
+                    break
+                if self.stop_event.is_set():
+                    if self.cadence.is_coordinator:
+                        # end the epoch THROUGH the cadence (see the host
+                        # branch's batch-None path: a bare break abandons
+                        # the broadcast the followers are blocked in)
+                        self._drain_flag = True
+                        continue
+                    break
+                self._replay_key, sub = jax.random.split(self._replay_key)
+                t0 = time.perf_counter()
+                rows = self.device_replay.sample_host(sub, fused * B_local)
+                if fused > 1:
+                    # i.i.d. draws: slicing fused*B rows into k groups is
+                    # equivalent to k independent B-row samples
+                    batch = self.ctx.put_batches([
+                        jax.tree.map(
+                            lambda x, i=i: x[i * B_local:(i + 1) * B_local],
+                            rows,
+                        )
+                        for i in range(fused)
+                    ])
+                else:
+                    batch = self.ctx.put_batch(rows)
+                sample_wait = time.perf_counter() - t0
+                trace_event("batch.wait", sample_wait, plane="learner")
+                if self._warmup_wait_pending:
+                    self._warmup_wait_pending = False
+                    warmup_wait_s = sample_wait
+                else:
+                    wait_s += sample_wait  # data-plane time (north-star)
+                last_batch = batch  # batches aren't donated; safe to re-lower
+                step_lr = self._step_lr(lr, fused)
+                self._arm("train_step @ step %d" % self.steps)
+                try:
+                    with trace_span("train_step", plane="learner"):
+                        if fused > 1:
+                            self.state, metrics = self.ctx.train_steps(self.state, batch, step_lr)
+                        else:
+                            self.state, metrics = self.ctx.train_step(self.state, batch, step_lr)
+                finally:
+                    self._disarm()
+                self._collective_dispatched = True
+                metric_accum.append(metrics)
+                batch_cnt += fused
+                self.steps += fused
+                self._maybe_publish_params()
+                self._maybe_fault_sigterm()
+                data_cnt = 1
+                if on_cpu:
+                    # same rollout-thread fairness as the fused path: the
+                    # local sample re-takes the actor-overlapping dispatch
+                    # locks every iteration on the CPU backend
+                    time.sleep(0.02)
         else:
             from ..parallel.distributed import CMD_END
 
-            last_batch = None
             while True:
                 if self.cadence is not None:
                     # coordinator-broadcast epoch end: every process runs
@@ -933,10 +1012,7 @@ class Trainer:
             # Resolution happens AFTER `elapsed` is taken: a multi-second
             # lowering must not deflate the first epoch's rate stats.
             if self._flops_per_update is None:
-                self._resolve_flops(train if self.device_replay is not None
-                                    else None,
-                                    None if self.device_replay is not None
-                                    else last_batch)
+                self._resolve_flops(replay_train, last_batch)
             if self._flops_per_update:
                 self.stats["mfu"] = round(
                     self._flops_per_update * batch_cnt

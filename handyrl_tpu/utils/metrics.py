@@ -92,6 +92,11 @@ METRIC_KEYS = frozenset({
     # final pre-exit drain record (runtime/learner.py)
     "dist_processes", "dist_heartbeat_misses", "dist_collective_timeouts",
     "dist_peer_loss_drains",
+    # pod-slice actor tier (runtime/plane.py PlaneGateway): live producer
+    # count at the epoch boundary plus cumulative disconnect-after-hello
+    # losses (each one a degrade the surviving hosts absorbed — never a
+    # wedge, by the fault matrix's asymmetry)
+    "dist_actor_hosts", "dist_actor_host_losses",
     # observability plane (docs/observability.md): every record carries
     # both clocks from the single _write_metrics seam — ts (wall, absolute
     # cross-host alignment) and t_mono (monotonic, NTP-step-immune rate

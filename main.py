@@ -52,18 +52,28 @@ if __name__ == "__main__":
 
     mode = sys.argv[1]
 
-    if mode in ("--train", "-t"):
-        from handyrl_tpu.parallel import init_distributed
-        from handyrl_tpu.runtime.learner import train_main
+    if mode in ("--train", "-t", "--train-server", "-ts"):
+        dist = args["train_args"].get("distributed") or {}
+        if dist.get("role") == "actor":
+            # dedicated actor host (docs/performance.md §Pod-slice
+            # topology): deliberately OUTSIDE jax.distributed — it talks
+            # to the learner tier over the plane gateway only, so losing
+            # it can never wedge the learner collective
+            from handyrl_tpu.runtime.actor_host import actor_host_main
 
-        init_distributed(args["train_args"].get("distributed"))
-        train_main(args)
-    elif mode in ("--train-server", "-ts"):
-        from handyrl_tpu.parallel import init_distributed
-        from handyrl_tpu.runtime.learner import train_server_main
+            actor_host_main(args)
+        else:
+            from handyrl_tpu.parallel import init_distributed
 
-        init_distributed(args["train_args"].get("distributed"))
-        train_server_main(args)
+            init_distributed(dist)
+            if mode in ("--train", "-t"):
+                from handyrl_tpu.runtime.learner import train_main
+
+                train_main(args)
+            else:
+                from handyrl_tpu.runtime.learner import train_server_main
+
+                train_server_main(args)
     elif mode in ("--worker", "-w"):
         from handyrl_tpu.runtime.server import worker_main
 

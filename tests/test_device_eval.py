@@ -149,6 +149,8 @@ def test_learner_device_eval_rejects_episodic_twin(tmp_path, monkeypatch):
         Learner(cfg)
 
 
+@pytest.mark.slow  # heaviest single test in the fast tier (~47s of
+# compiles on 1 CPU core); the slow CI leg keeps it green
 def test_learner_device_eval_records_curve(tmp_path, monkeypatch):
     """A device_replay run with device_eval_games must record a win_rate
     entry EVERY epoch — the host-worker curve starves on slow hosts (the

@@ -420,22 +420,31 @@ def test_distributed_knob_validation():
     assert _cfg({"coordinator_address": "10.0.0.1:65535", "heartbeat_interval": 0})
 
 
-def test_multiprocess_rejects_per_process_local_planes():
+def test_multiprocess_composes_with_device_planes():
+    """The PR-6/PR-12 blanket rejections are GONE: the device data plane
+    composes with the multi-process cadence (pod-slice rung 1).  The
+    exact configs the old rejections refused must now validate."""
     from handyrl_tpu.config import normalize_args
 
     dist = {"num_processes": 2, "coordinator_address": "127.0.0.1:6000"}
-    with pytest.raises(ValueError, match="device_replay"):
-        normalize_args(
-            {"env_args": {"env": "TicTacToe"},
-             "train_args": {"distributed": dict(dist),
-                            "device_rollout_games": 8, "device_replay": True}}
-        )
-    with pytest.raises(ValueError, match="plane: split"):
-        normalize_args(
-            {"env_args": {"env": "TicTacToe"},
-             "train_args": {"distributed": dict(dist),
-                            "device_rollout_games": 8, "plane": "split"}}
-        )
+    ok = normalize_args(
+        {"env_args": {"env": "TicTacToe"},
+         "train_args": {"distributed": dict(dist),
+                        "device_rollout_games": 8, "device_replay": True}}
+    )
+    assert ok["train_args"]["device_replay"] is True
+    ok = normalize_args(
+        {"env_args": {"env": "TicTacToe"},
+         "train_args": {"distributed": dict(dist),
+                        "device_rollout_games": 8, "plane": "split"}}
+    )
+    assert ok["train_args"]["plane"] == "split"
+    ok = normalize_args(
+        {"env_args": {"env": "TicTacToe"},
+         "train_args": {"distributed": dict(dist),
+                        "batch_pipeline": "device"}}
+    )
+    assert ok["train_args"]["batch_pipeline"] == "device"
     # num_processes alone may be a fleet template: without a
     # coordinator_address the plane never activates (init_distributed
     # returns 0), so the same knobs must VALIDATE
@@ -445,3 +454,66 @@ def test_multiprocess_rejects_per_process_local_planes():
                         "device_rollout_games": 8, "plane": "split"}}
     )
     assert ok["train_args"]["plane"] == "split"
+
+
+def test_multiprocess_shard_divisibility_validation():
+    """What replaced the blanket rejections: the per-process SHARDS must
+    divide evenly, and the error names the offending knob."""
+    from handyrl_tpu.config import normalize_args
+
+    dist = {"num_processes": 2, "coordinator_address": "127.0.0.1:6000"}
+    with pytest.raises(ValueError, match="batch_size"):
+        normalize_args(
+            {"env_args": {"env": "TicTacToe"},
+             "train_args": {"distributed": dict(dist), "batch_size": 7}}
+        )
+    with pytest.raises(ValueError, match="device_rollout_games"):
+        normalize_args(
+            {"env_args": {"env": "TicTacToe"},
+             "train_args": {"distributed": dict(dist),
+                            "device_rollout_games": 7,
+                            "device_replay": True}}
+        )
+    # no coordinator_address = plane never activates: same knobs validate
+    assert normalize_args(
+        {"env_args": {"env": "TicTacToe"},
+         "train_args": {"distributed": {"num_processes": 2},
+                        "batch_size": 7}}
+    )
+
+
+def test_pod_slice_knob_validation():
+    """distributed.role / plane_port / actor_hosts fail loudly, naming
+    the knob (CFG005 keeps these documented in docs/parameters.md)."""
+    with pytest.raises(ValueError, match="role"):
+        _cfg({"role": "observer"})
+    with pytest.raises(ValueError, match="plane_port"):
+        _cfg({"plane_port": 99999})
+    with pytest.raises(ValueError, match="actor_hosts"):
+        _cfg({"actor_hosts": -1})
+    # the actor tier hangs off the coordinator host: both ends need the
+    # address to derive the gateway endpoint
+    with pytest.raises(ValueError, match="coordinator_address"):
+        _cfg({"actor_hosts": 1})
+    with pytest.raises(ValueError, match="coordinator_address"):
+        _cfg({"role": "actor"})
+    # a dedicated actor host without the on-device rollout is a no-op
+    from handyrl_tpu.config import normalize_args
+
+    with pytest.raises(ValueError, match="device_rollout_games"):
+        normalize_args(
+            {"env_args": {"env": "TicTacToe"},
+             "train_args": {"distributed": {
+                 "role": "actor",
+                 "coordinator_address": "127.0.0.1:6000"}}}
+        )
+    # derived plane port overflow: health port 65534 -> plane 65535 is the
+    # last valid port; health_port 65534 + 1 = 65535 ok, but a derived
+    # 65535 + 1 demands an explicit plane_port
+    assert _cfg({"coordinator_address": "10.0.0.1:1234", "actor_hosts": 1,
+                 "health_port": 65534})
+    with pytest.raises(ValueError, match="plane_port"):
+        _cfg({"coordinator_address": "10.0.0.1:1234", "actor_hosts": 1,
+              "health_port": 65535})
+    assert _cfg({"coordinator_address": "10.0.0.1:1234", "actor_hosts": 1,
+                 "health_port": 65535, "plane_port": 7777})

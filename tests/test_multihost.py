@@ -455,7 +455,9 @@ port, hport, pid, nproc, outdir = (
 )
 extra = json.loads(sys.argv[6]) if len(sys.argv) > 6 else {}
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=%d" % int(extra.get("devices", 2))
+)
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -475,6 +477,7 @@ dist = {
     "collective_timeout": 300.0,
     "health_port": hport,
 }
+dist.update(extra.get("dist") or {})
 init_distributed(dist)
 
 shared_dir = bool(extra.get("shared_dir"))
@@ -498,7 +501,9 @@ train = {
     "distributed": dist,
 }
 train.update(extra.get("train") or {})
-args = normalize_args({"env_args": {"env": "TicTacToe"}, "train_args": train})
+args = normalize_args(
+    {"env_args": {"env": extra.get("env", "TicTacToe")}, "train_args": train}
+)
 
 from handyrl_tpu.runtime.learner import Learner
 
@@ -521,7 +526,7 @@ sys.exit(code)
 """
 
 
-def _spawn_learners(tmp_path, extra=None, env_extra=None, nproc=2):
+def _spawn_learners(tmp_path, extra=None, env_extra=None, nproc=2, log_files=False):
     port, hport = _free_port(), _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
@@ -530,16 +535,28 @@ def _spawn_learners(tmp_path, extra=None, env_extra=None, nproc=2):
     if env_extra:
         env.update(env_extra)
     blob = json.dumps(extra or {})
-    return [
-        subprocess.Popen(
-            [sys.executable, "-c", _LEARNER_CHILD, str(port), str(hport),
-             str(pid), str(nproc), str(tmp_path), blob],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
+    if log_files:
+        # unbounded-duration children (the host-loss e2es kill or outlive
+        # them) must not block on a full stdout PIPE; unbuffered so the
+        # poll loops see lines as they are printed
+        env["PYTHONUNBUFFERED"] = "1"
+    procs = []
+    for pid in range(nproc):
+        stdout = (
+            open(os.path.join(str(tmp_path), f"learner_{pid}.log"), "wb")
+            if log_files
+            else subprocess.PIPE
         )
-        for pid in range(nproc)
-    ]
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _LEARNER_CHILD, str(port), str(hport),
+                 str(pid), str(nproc), str(tmp_path), blob],
+                env=env,
+                stdout=stdout,
+                stderr=subprocess.STDOUT,
+            )
+        )
+    return procs
 
 
 def test_two_process_learner_epoch_loop(tmp_path):
@@ -975,3 +992,312 @@ def test_coordinator_death_survivor_exits_loudly(tmp_path):
     assert loud_health or loud_service, (
         f"follower exit (rc={rc1}) was not loud about the coordinator:\n{outs[1]}"
     )
+
+
+# ---------------------------------------------------------------------------
+# PR 19: pod-slice — device planes + the actor-host tier under jax.distributed
+# ---------------------------------------------------------------------------
+
+
+def _assert_bit_identical_finals(tmp_path, nproc=2, tag=""):
+    import numpy as np
+
+    done = [
+        json.load(open(tmp_path / f"done_{pid}{tag}.json")) for pid in range(nproc)
+    ]
+    for d in done:
+        assert d["code"] == 0
+        assert d["steps"] > 0
+    assert len({d["steps"] for d in done}) == 1, done
+    dumps = [np.load(tmp_path / f"final_{pid}{tag}.npz") for pid in range(nproc)]
+    keys = sorted(dumps[0].files, key=lambda s: int(s.split("_")[1]))
+    assert keys
+    for k in keys:
+        for d in dumps[1:]:
+            np.testing.assert_array_equal(dumps[0][k], d[k], err_msg=k)
+
+
+@pytest.mark.slow
+def test_two_process_device_batch_pipeline_parity(tmp_path):
+    """Tentpole acceptance pin (rung 1): `batch_pipeline: device` under a
+    REAL 2-process run.  Each process stages its own host-born episodes
+    into process-LOCAL device rings, samples its shard of the global batch
+    on its own devices, and the shards meet the collective train step
+    through the make_array_from_process_local_data seam — params must stay
+    bit-identical on both ranks after 2 epochs, and the metrics must show
+    the DEVICE pipeline actually ran (a silent fall-back to threads would
+    pass the parity check while testing nothing)."""
+    procs = _spawn_learners(tmp_path, extra={
+        "epochs": 2,
+        "heartbeat_timeout": 45.0,
+        "train": {
+            "batch_pipeline": "device",
+            # TicTacToe turn mode on the device stage needs the observation
+            # flag (windows carry all-player observation rows)
+            "observation": True,
+            "device_stage_lanes": 4,
+            "device_stage_chunk": 8,
+            "device_stage_slots": 64,
+            "eval_rate": 0.0,
+        },
+    })
+    outs = [p.communicate(timeout=420)[0].decode(errors="replace") for p in procs]
+    codes = [p.returncode for p in procs]
+    assert codes == [0, 0], "".join(
+        f"\n---- rank {i} rc={codes[i]} ----\n{out}" for i, out in enumerate(outs)
+    )
+    _assert_bit_identical_finals(tmp_path)
+    records = [
+        json.loads(l) for l in open(tmp_path / "metrics_0.jsonl") if l.strip()
+    ]
+    assert any(r.get("pipeline") == "device" for r in records), (
+        [r.get("pipeline") for r in records], outs[0]
+    )
+
+
+@pytest.mark.slow
+def test_two_process_split_plane_device_pipeline_e2e(tmp_path):
+    """Tentpole acceptance pin (rung 1, the pod-slice shape itself): a
+    REAL 2-process run where each rank's 4 virtual devices are carved
+    2 + 2 — the leading pair joins the GLOBAL learner mesh (collective
+    train step across hosts), the trailing pair is that rank's process-
+    local actor plane running the streaming device rollout into its own
+    DeviceReplay rings.  Per-rank RNGs are decorrelated (seed +
+    1009*rank), so the ranks ingest DIFFERENT episodes and sample
+    DIFFERENT local shards, yet the collective step must keep params
+    bit-identical on both processes; the coordinator's metrics must carry
+    the plane-health keys with both planes having actually worked."""
+    procs = _spawn_learners(tmp_path, extra={
+        "devices": 4,
+        "env": "ParallelTicTacToe",
+        "epochs": 2,
+        "heartbeat_timeout": 45.0,
+        "train": {
+            "plane": "split",
+            "actor_chips": 2,
+            "param_refresh_updates": 2,
+            # two ranks compiling rollout + ingest + the collective step
+            # concurrently on shared host cores can silence the rollout
+            # thread for minutes; the default 120s bound would degrade a
+            # HEALTHY run split -> fused mid-test (seen in CI soak)
+            "plane_stall_timeout": 600.0,
+            "mesh": {"dp": -1},
+            "turn_based_training": False,
+            "observation": False,
+            "batch_size": 8,
+            "forward_steps": 4,
+            "burn_in_steps": 0,
+            "device_rollout_games": 8,
+            "device_replay": True,
+            "device_replay_slots": 64,
+            "device_replay_k_steps": 16,
+            "minimum_episodes": 20,
+            "update_episodes": 30,
+            "maximum_episodes": 400,
+            "eval_rate": 0.0,
+            "worker": {"num_parallel": 1},
+        },
+    })
+    outs = [p.communicate(timeout=420)[0].decode(errors="replace") for p in procs]
+    codes = [p.returncode for p in procs]
+    assert codes == [0, 0], "".join(
+        f"\n---- rank {i} rc={codes[i]} ----\n{out}" for i, out in enumerate(outs)
+    )
+    _assert_bit_identical_finals(tmp_path)
+    records = [
+        json.loads(l) for l in open(tmp_path / "metrics_0.jsonl") if l.strip()
+    ]
+    assert records[-1].get("dist_processes") == 2
+    epoch_rows = [r for r in records if "plane_actor_busy_frac" in r]
+    assert epoch_rows, f"no plane_* keys in metrics_0.jsonl: {records}"
+    assert max(r["plane_actor_busy_frac"] for r in epoch_rows) > 0
+    assert max(r["plane_xfer_bytes_per_sec"] for r in epoch_rows) > 0
+
+
+# rung 2: a dedicated actor host — runs ONLY the data plane (streaming
+# device rollout), ships records to the learner's plane gateway over TCP,
+# polls versioned params back.  Deliberately outside jax.distributed.
+_ACTOR_CHILD = r"""
+import json, os, sys
+
+outdir = sys.argv[1]
+extra = json.loads(sys.argv[2])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=%d" % int(extra.get("devices", 2))
+)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from handyrl_tpu.config import normalize_args
+from handyrl_tpu.runtime.actor_host import actor_host_main
+
+args = normalize_args(
+    {"env_args": {"env": extra.get("env", "ParallelTicTacToe")},
+     "train_args": extra["train"]}
+)
+actor_host_main(args)
+"""
+
+
+def _pod_slice_train(plane_port):
+    # one learner process (2 virtual devices, fused plane, device replay)
+    # + one actor host shipping over the gateway; the learner's OWN
+    # streaming rollout keeps generating too, so losing the actor host
+    # degrades throughput without stalling the cadence
+    return {
+        "turn_based_training": False,
+        "observation": False,
+        "batch_size": 8,
+        "forward_steps": 4,
+        "burn_in_steps": 0,
+        "plane_stall_timeout": 600.0,  # compile storms are not stalls
+        "device_rollout_games": 8,
+        "device_replay": True,
+        "device_replay_slots": 64,
+        "device_replay_k_steps": 16,
+        "minimum_episodes": 20,
+        "update_episodes": 30,
+        "maximum_episodes": 4000,
+        "eval_rate": 0.0,
+        "worker": {"num_parallel": 1},
+        "mesh": {"dp": -1},
+        # NO "distributed" key: the learner child's dist dict (which
+        # carries actor_hosts + plane_port via extra["dist"]) must survive
+        # the train.update() merge; _spawn_actor overrides it wholesale
+    }
+
+
+def _spawn_actor(tmp_path, plane_port, log_path, extra=None):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"  # the tests poll the log for lines
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    train = _pod_slice_train(plane_port)
+    train["distributed"] = {
+        # host part is what the actor dials; the port is the explicit
+        # plane_port, so the coordinator port here is never used
+        "coordinator_address": "127.0.0.1:6000",
+        "num_processes": 1,
+        "process_id": 0,
+        "role": "actor",
+        "plane_port": plane_port,
+        "initialization_timeout": 180.0,
+    }
+    blob = json.dumps(dict(extra or {}, train=train))
+    return subprocess.Popen(
+        [sys.executable, "-c", _ACTOR_CHILD, str(tmp_path), blob],
+        env=env,
+        stdout=open(log_path, "wb"),
+        stderr=subprocess.STDOUT,
+    )
+
+
+def _await_actor_connected(actor, log_path, learners, deadline_s=240):
+    import time
+
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        text = log_path.read_bytes() if log_path.exists() else b""
+        if b"connected to plane gateway" in text:
+            return
+        assert actor.poll() is None, (
+            f"actor host died before connecting (rc={actor.returncode}):\n"
+            + text.decode(errors="replace")
+        )
+        for p in learners:
+            assert p.poll() is None, (
+                f"learner exited (rc={p.returncode}) before the actor host "
+                "connected"
+            )
+        time.sleep(0.5)
+    raise AssertionError(
+        "actor host never connected:\n"
+        + (log_path.read_bytes().decode(errors="replace") if log_path.exists() else "")
+    )
+
+
+@pytest.mark.slow
+def test_actor_host_loss_is_degradable(tmp_path):
+    """Fault-matrix pin (rung 2, the degradable direction): killing a
+    connected actor host must NOT gate the learner — the gateway logs the
+    disconnect, bumps dist_actor_host_losses, and the learner's own
+    rollout absorbs the game quota to a clean exit-0 finish."""
+    plane_port = _free_port()
+    learners = _spawn_learners(
+        tmp_path,
+        nproc=1,
+        log_files=True,  # no PIPE: nobody reads while we await the actor
+        extra={
+            "env": "ParallelTicTacToe",
+            "epochs": 3,
+            "heartbeat_timeout": 45.0,
+            "dist": {"actor_hosts": 1, "plane_port": plane_port},
+            "train": _pod_slice_train(plane_port),
+        },
+    )
+    actor_log = tmp_path / "actor.log"
+    actor = _spawn_actor(tmp_path, plane_port, actor_log)
+    try:
+        _await_actor_connected(actor, actor_log, learners)
+    finally:
+        actor.kill()
+    actor.wait(timeout=60)
+    try:
+        learners[0].wait(timeout=420)
+    finally:
+        if learners[0].poll() is None:
+            learners[0].kill()
+    out = (tmp_path / "learner_0.log").read_bytes().decode(errors="replace")
+    assert learners[0].returncode == 0, out
+    records = [
+        json.loads(l) for l in open(tmp_path / "metrics_0.jsonl") if l.strip()
+    ]
+    tiered = [r for r in records if "dist_actor_host_losses" in r]
+    assert tiered, f"no actor-tier keys in metrics: {records}"
+    assert tiered[-1]["dist_actor_host_losses"] >= 1, (tiered, out)
+    # before the kill the host was COUNTED live at least once, or records
+    # actually landed (either proves the tier was attached, not idle)
+    assert (
+        max(r["dist_actor_hosts"] for r in tiered) >= 1
+        or "plane: records" in out
+        or any(r.get("plane_xfer_bytes_per_sec", 0) > 0 for r in records)
+    ), (tiered, out)
+
+
+@pytest.mark.slow
+def test_learner_loss_actor_exits_75(tmp_path):
+    """Fault-matrix pin (rung 2, the loud direction): when the learner
+    tier dies, a dedicated actor host must NOT spin generating against
+    unowned params — its next gateway call raises, it announces the fault
+    and exits 75 (EX_TEMPFAIL) for the supervisor to relaunch."""
+    plane_port = _free_port()
+    learners = _spawn_learners(
+        tmp_path,
+        nproc=1,
+        log_files=True,  # killed mid-run: must not block on a full PIPE
+        extra={
+            "env": "ParallelTicTacToe",
+            "epochs": 1000,
+            "heartbeat_timeout": 45.0,
+            "dist": {"actor_hosts": 1, "plane_port": plane_port},
+            "train": dict(_pod_slice_train(plane_port), maximum_episodes=10 ** 7),
+        },
+    )
+    actor_log = tmp_path / "actor.log"
+    actor = _spawn_actor(tmp_path, plane_port, actor_log)
+    try:
+        _await_actor_connected(actor, actor_log, learners)
+        learners[0].kill()
+        learners[0].wait(timeout=60)
+        rc = actor.wait(timeout=420)
+    finally:
+        for p in learners + [actor]:
+            if p.poll() is None:
+                p.kill()
+    out = actor_log.read_bytes().decode(errors="replace")
+    assert rc == 75, f"actor rc={rc}:\n{out}"
+    assert "plane gateway lost" in out, out
+    assert "host fault (learner_loss)" in out, out

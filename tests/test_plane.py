@@ -421,3 +421,118 @@ def test_learner_split_plane_end_to_end(tmp_path, monkeypatch):
     # the trainer surfaced its realized staleness + refresh count
     assert learner.trainer.stats.get("plane_param_refreshes", 0) > 0
     assert learner.trainer.param_cache.version > 0
+
+
+# ------------------------------------------------- rung 2: cross-host wire
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _gw_dist(port):
+    # explicit plane_port: the tests must not depend on health-port
+    # derivation (and must not collide with anything else on the host)
+    return {"coordinator_address": "127.0.0.1:6000", "plane_port": port}
+
+
+def test_plane_wire_pack_round_trip():
+    from handyrl_tpu.runtime.plane import _pack_tree, _unpack_tree
+
+    tree = {
+        "a": {"b": np.arange(6, dtype=np.float32).reshape(2, 3)},
+        "c": np.array([1, -2], dtype=np.int8),
+    }
+    out = _unpack_tree(_pack_tree(tree))
+    assert out["a"]["b"].dtype == np.float32
+    np.testing.assert_array_equal(out["a"]["b"], tree["a"]["b"])
+    np.testing.assert_array_equal(out["c"], tree["c"])
+    # non-dict containers cannot round-trip the self-describing flattening
+    with pytest.raises(ValueError, match="nested dicts"):
+        _pack_tree({"a": [np.zeros(2)]})
+    with pytest.raises(ValueError, match="separator"):
+        _pack_tree({"a\x1fb": np.zeros(2)})
+
+
+def test_plane_gateway_round_trip():
+    """Records in, versioned params out, monotone versions, byte counts,
+    and the clean-stop protocol — one gateway, one client, real sockets."""
+    from handyrl_tpu.runtime.plane import PlaneClient, PlaneGateway
+
+    dist = _gw_dist(_free_port())
+    received = []
+    gw = PlaneGateway(dist, on_records=received.append)
+    gw.start()
+    client = PlaneClient(dist, timeout=10.0)
+    try:
+        gw.publish({"w": np.float32([1.0, 2.0])}, 10)
+        assert client.connect(retry_for=10.0) == 10
+        version, params = client.poll_params(have=-1)
+        assert version == 10
+        np.testing.assert_array_equal(params["w"], np.float32([1.0, 2.0]))
+        # caught up: no payload rides the reply
+        version, params = client.poll_params()
+        assert version == 10 and params is None
+        # records land in on_records BEFORE the reply (the ingest is the
+        # ack), and the reply carries the poll hint
+        recs = {"obs": np.zeros((4, 2), np.float32), "rew": np.ones((4,), np.float32)}
+        assert client.ship_records(recs) == 10
+        assert len(received) == 1
+        np.testing.assert_array_equal(received[0]["obs"], recs["obs"])
+        gw.publish({"w": np.float32([3.0, 4.0])}, 20)
+        assert client.ship_records(recs) == 20
+        version, fresh = client.poll_params()
+        assert version == 20 and fresh is not None
+        assert client.param_version == 20
+        assert gw.record_batches == 2
+        assert gw.bytes_in > 0 and gw.bytes_out > 0
+        assert gw.bytes_transferred == gw.bytes_in + gw.bytes_out
+        assert gw.lag(23) == 3
+        with pytest.raises(ValueError, match="monotonically"):
+            gw.publish({"w": np.zeros(2, np.float32)}, 20)
+        assert gw.actor_hosts == 1 and gw.actor_hosts_seen == 1
+        # run concluding: the next request is answered with a clean stop —
+        # the client reports None (exit 0 path), NOT a counted loss
+        gw.begin_stop()
+        assert client.ship_records(recs) is None
+        assert client.stopped
+        client.close()
+        deadline = time.time() + 5.0
+        while gw.actor_hosts > 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert gw.actor_host_losses == 0
+    finally:
+        client.close()
+        gw.stop()
+
+
+def test_plane_gateway_counts_actor_host_loss():
+    """Disconnect-after-hello while the run is live = a LOSS the books
+    must show (dist_actor_host_losses); the gateway keeps serving."""
+    from handyrl_tpu.runtime.plane import PlaneClient, PlaneGateway
+
+    dist = _gw_dist(_free_port())
+    gw = PlaneGateway(dist, on_records=lambda r: None)
+    gw.start()
+    try:
+        gw.publish({"w": np.zeros(2, np.float32)}, 1)
+        client = PlaneClient(dist, timeout=10.0)
+        client.connect(retry_for=10.0)
+        client.close()   # vanish mid-run, no goodbye protocol exists
+        deadline = time.time() + 5.0
+        while gw.actor_host_losses == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert gw.actor_host_losses == 1
+        assert gw.actor_hosts == 0
+        # the gateway survives its lost producer: a new client connects
+        client2 = PlaneClient(dist, timeout=10.0)
+        assert client2.connect(retry_for=10.0) == 1
+        client2.close()
+    finally:
+        gw.stop()

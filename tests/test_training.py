@@ -175,6 +175,8 @@ def test_geister_rnn_train_step():
     assert np.isfinite(m["r"])  # return head in play
 
 
+@pytest.mark.slow  # ~40s of unroll-vs-scan recompiles on 1 CPU core;
+# the slow CI leg keeps it green
 def test_geister_rnn_unroll_remat_match_scan():
     """The CPU-fallback strategy (fully unrolled scan) and the TPU strategy
     (looped scan + jax.checkpoint remat) must produce the same update as

@@ -237,6 +237,12 @@ class LintConfig:
         # engine apply and the ring sample/forward programs: a host sync
         # here would serialize every quantized inference and train window
         "handyrl_tpu/models/quantize.py",
+        # the cross-host plane transports run on threads beside the
+        # trainer's dispatch stream and inside the actor host's rollout
+        # loop: every host materialization must be an annotated transport
+        # boundary, not an accidental sync
+        "handyrl_tpu/runtime/plane.py",
+        "handyrl_tpu/runtime/actor_host.py",
     )
     # functions (bare names) that are drain/teardown/construction paths —
     # host syncs there are the POINT, not a leak
@@ -257,6 +263,9 @@ class LintConfig:
         "handyrl_tpu/runtime/learner.py",
         "handyrl_tpu/runtime/device_*.py",
         "handyrl_tpu/runtime/plane.py",
+        # the actor host's streaming rollout dispatches onto its local
+        # mesh concurrently with param polls: same lock discipline
+        "handyrl_tpu/runtime/actor_host.py",
         "handyrl_tpu/runtime/shm_batch.py",
         "handyrl_tpu/parallel/train_step.py",
         # per-model serving engines share chips with each other (and, co-
