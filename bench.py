@@ -2660,12 +2660,167 @@ def _lowprec_bench(duration: float):
     return out
 
 
+def _flywheel_bench(duration: float):
+    """Data-flywheel bench (docs/serving.md §Data flywheel) over the REAL
+    framed-socket transport: harvest assembly rate (scripted clients play
+    full games through per-player sessions and close each step over the
+    harvest protocol), ingest drain rate in wire bytes/s, and the quality
+    plane's two latencies — snapshot-available -> gated promotion flip,
+    and first bad outcome -> sentinel demote-to-incumbent."""
+    import random as _random
+    import tempfile
+
+    import numpy as np
+
+    from handyrl_tpu.envs import make_env
+    from handyrl_tpu.flywheel import FlywheelPlane
+    from handyrl_tpu.models import init_variables
+    from handyrl_tpu.runtime.checkpoint import save_epoch_snapshot
+    from handyrl_tpu.serving import ModelRouter, ServingClient, ServingServer
+
+    env = make_env({"env": "TicTacToe"})
+    module = env.net()
+    env.reset()
+    obs0 = env.observation(0)
+    p1 = init_variables(module, env, seed=1)["params"]
+    p2 = init_variables(module, env, seed=2)["params"]
+
+    model_dir = tempfile.mkdtemp(prefix="bench_flywheel_")
+    save_epoch_snapshot(model_dir, 1, p1, {"bench": 0}, 0)
+
+    promote_games = 8
+    quality_window = 4
+    fly_cfg = {
+        "enabled": True, "gate_promotions": True, "promote_winrate": 0.55,
+        "promote_games": promote_games, "quality_window": quality_window,
+        "demote_drop": 0.1, "shadow_fraction": 0.0,
+        "harvest_max_open": 512, "harvest_ttl_s": 600.0,
+    }
+    gen_args = {"gamma": 0.8, "compress_steps": 8, "observation": True,
+                "obs_int8": False}
+    cfg = {
+        "port": 0, "max_models": 4, "slo_ms": 1000.0, "shed_policy": "none",
+        "max_batch": 64, "max_wait_ms": 1.0,
+        "warm_buckets": [1, 2, 4, 8, 16],
+        "queue_bound": 8192, "recv_timeout": 0.0, "watch_interval": 0.2,
+        "stats_interval": 0.0,
+    }
+    router = ModelRouter(module, obs0, cfg, model_dir=model_dir)
+    router.publish(1, p1)
+    flywheel = FlywheelPlane(router, model_dir, fly_cfg, gen_args)
+    server = ServingServer(router, cfg, flywheel=flywheel).run()
+    out = {}
+    client = ServingClient("127.0.0.1", server.bound_port)
+    try:
+        players = env.players()
+
+        def play_one():
+            """One full game over the wire: per-player sessions bound into
+            a harvest episode, policies sampled from the served replies."""
+            sids = [client.open_session() for _ in players]
+            hid = client.harvest_open(players, sids)
+            env.reset()
+            while not env.terminal():
+                turn_players = env.turns()
+                actions = [None] * len(players)
+                legal_lists = [None] * len(players)
+                moves = {}
+                for p in turn_players:
+                    j = players.index(p)
+                    reply = client.infer(env.observation(p), sid=sids[j])
+                    logits = np.asarray(reply["out"]["policy"]).reshape(-1)
+                    legal = env.legal_actions(p)
+                    action = max(legal, key=lambda a: (logits[a], _random.random()))
+                    actions[j] = int(action)
+                    legal_lists[j] = list(legal)
+                    moves[p] = int(action)
+                turn = turn_players[0] if turn_players else None
+                env.step(moves)
+                reward = env.reward()
+                rewards = [reward.get(p) for p in players]
+                client.harvest_step(hid, actions, legal_lists, rewards, turn)
+            outcome = env.outcome()
+            kept = client.harvest_close(hid, [outcome.get(p, 0.0) for p in players])
+            for sid in sids:
+                client.close_session(sid)
+            return kept
+
+        # -- phase 1: harvest assembly over the wire ----------------------
+        episodes = 0
+        t0 = time.perf_counter()
+        end = t0 + duration
+        while time.perf_counter() < end:
+            if play_one():
+                episodes += 1
+        harvest_s = time.perf_counter() - t0
+        out["episodes"] = episodes
+        out["harvest_eps_per_sec"] = episodes / max(harvest_s, 1e-6)
+
+        # -- phase 2: ingest drain rate (the learner poll's wire cost) ----
+        _sent0, recv0 = client.wire_bytes()
+        pulled = 0
+        t0 = time.perf_counter()
+        while True:
+            eps, counts = client.harvest_pull(max_episodes=64)
+            pulled += len(eps)
+            if not eps:
+                break
+        pull_s = time.perf_counter() - t0
+        _sent1, recv1 = client.wire_bytes()
+        out["pull_episodes"] = pulled
+        out["ingest_bytes_per_sec"] = (recv1 - recv0) / max(pull_s, 1e-6)
+        out["dropped"] = (counts.get("flywheel_dropped_malformed", 0)
+                          + counts.get("flywheel_dropped_truncated", 0))
+
+        def wait_for(pred, timeout=30.0):
+            t = time.perf_counter()
+            while time.perf_counter() - t < timeout:
+                if pred():
+                    return True
+                time.sleep(0.02)
+            return False
+
+        # -- phase 3: gated promotion latency -----------------------------
+        # snapshot 2 lands -> watch loop stages it -> live wins clear the
+        # gate -> latest flips.  The measured span is the whole mechanism
+        t0 = time.perf_counter()
+        save_epoch_snapshot(model_dir, 2, p2, {"bench": 0}, 0)
+        staged = wait_for(lambda: router.candidate_id() == 2)
+        if staged:
+            for _ in range(promote_games):
+                client.report_outcome(2, 1.0)
+        promoted = wait_for(lambda: router.latest_id() == 2)
+        out["promote_latency_ms"] = (time.perf_counter() - t0) * 1000.0
+        out["promote_observed"] = promoted
+
+        # -- phase 4: sentinel demote latency -----------------------------
+        # the promoted snapshot turns bad live: losses past the window
+        # drag its EMA under the bar and the sentinel restores epoch 1
+        t0 = time.perf_counter()
+        demoted = False
+        if promoted:
+            for _ in range(quality_window * 2):
+                client.report_outcome(2, -1.0)
+            demoted = wait_for(lambda: router.latest_id() == 1)
+        out["demote_ms"] = (time.perf_counter() - t0) * 1000.0
+        out["demote_observed"] = demoted
+
+        q = flywheel.stats_record()
+        out["promotions"] = q.get("quality_promotions", 0)
+        out["demotions"] = q.get("quality_demotions", 0)
+        out["games"] = q.get("quality_games", 0)
+    finally:
+        client.close()
+        server.shutdown()
+    return out
+
+
 KNOWN_STAGES = (
     "tictactoe", "device-selfplay", "geese-device-selfplay", "geese-gen",
     "geese-train", "northstar", "northstar2", "northstar3", "northstar3mp",
     "northstar4",
     "geese-bf16", "geister", "geister-device-selfplay", "geister-devreplay",
-    "serving", "fleet", "league", "lowprec", "transformer",
+    "serving", "fleet", "league", "lowprec", "flywheel", "transformer",
     "transformer_long", "flash",
 )
 # stages that consume another stage's result (main() gates them on it)
@@ -3392,6 +3547,42 @@ def main() -> None:
             )
 
     _run_stage(result, "lowprec", stage_lowprec)
+
+    # 3i. data flywheel (docs/serving.md §Data flywheel): harvest assembly
+    # rate over the real wire, ingest drain bytes/s, and the quality
+    # plane's promotion-gate and sentinel-demote latencies
+    def stage_flywheel():
+        fw = _flywheel_bench(T_TRAIN)
+        result["extra"]["flywheel_episodes"] = fw["episodes"]
+        result["extra"]["flywheel_harvest_eps_per_sec"] = _sig(
+            fw["harvest_eps_per_sec"]
+        )
+        result["extra"]["flywheel_pull_episodes"] = fw["pull_episodes"]
+        result["extra"]["flywheel_ingest_bytes_per_sec"] = _sig(
+            fw["ingest_bytes_per_sec"]
+        )
+        result["extra"]["flywheel_dropped"] = fw["dropped"]
+        result["extra"]["flywheel_promote_latency_ms"] = _sig(
+            fw["promote_latency_ms"]
+        )
+        result["extra"]["flywheel_demote_ms"] = _sig(fw["demote_ms"])
+        result["extra"]["flywheel_promotions"] = fw["promotions"]
+        result["extra"]["flywheel_demotions"] = fw["demotions"]
+        result["extra"]["flywheel_live_games"] = fw["games"]
+        if fw["dropped"]:
+            result["error"] = (result["error"] or "") + (
+                f" flywheel: {fw['dropped']} harvested episodes dropped"
+            )
+        if not fw["promote_observed"]:
+            result["error"] = (result["error"] or "") + (
+                " flywheel: gated promotion never flipped"
+            )
+        if not fw["demote_observed"]:
+            result["error"] = (result["error"] or "") + (
+                " flywheel: quality sentinel never demoted"
+            )
+
+    _run_stage(result, "flywheel", stage_flywheel)
 
     # 4c. turn-mode device-resident replay: Geister DRC trained straight
     # from device rings (all-player burn-in windows, runtime/device_replay

@@ -280,6 +280,12 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
         "replicas": [],
         # seconds between stats-frame polls feeding the load scores
         "stats_poll_s": 2.0,
+        # transient-fault budget for that poll (utils/retry.py): up to
+        # poll_retry_attempts retries with exponential backoff starting
+        # at poll_retry_backoff_s before a failing poll may declare the
+        # replica lost — one EINTR/ECONNRESET never costs a replica_lost
+        "poll_retry_attempts": 3,
+        "poll_retry_backoff_s": 0.1,
         # per-replica stall deadline: a replica silent this long with
         # proxied requests pending is declared lost (bounded failover);
         # 0 disables (failover then only on connection loss)
@@ -353,6 +359,49 @@ DEFAULT_TRAIN_ARGS: Dict[str, Any] = {
         # members retire from the pool first; their snapshots and payoff
         # books persist).  The anchor always stays active
         "max_population": 16,
+    },
+    # --- data flywheel (docs/serving.md §Data flywheel) ------------------
+    # quality-guarded production loop: the serving tier assembles served
+    # traffic into complete training episodes (harvest), the learner
+    # pulls them into its EpisodeStore alongside/instead of self-play,
+    # and promotions of new snapshots into serving are gated on LIVE win
+    # rate with an auto-rollback quality sentinel behind the gate
+    "flywheel": {
+        "enabled": False,
+        # fraction of each epoch's update_episodes budget filled from
+        # harvested traffic (the rest stays self-play); 1.0 = train on
+        # served traffic only, 0.0 = quality plane without harvest ingest
+        "harvest_fraction": 0.5,
+        # drop harvested episodes generated >= this many model epochs
+        # behind the learner's current epoch (staleness bound)
+        "staleness_epochs": 4,
+        # where the learner's ingest loop dials the serving tier; port 0
+        # follows serving.port
+        "harvest_host": "127.0.0.1",
+        "harvest_port": 0,
+        # ingest poll cadence / per-poll episode cap
+        "harvest_poll_s": 1.0,
+        "harvest_max_pull": 64,
+        # server-side harvest hygiene: an open episode idle past the TTL
+        # is dropped (counted truncated); at most max_open concurrent
+        # open episodes (the oldest sheds first)
+        "harvest_ttl_s": 600.0,
+        "harvest_max_open": 256,
+        # promotion gate: a fresh snapshot is staged as a shadow
+        # candidate on shadow_fraction of default-route traffic and the
+        # served `latest` flips only once its live win points over
+        # promote_games reported games clear promote_winrate; gating off
+        # = every fresh snapshot flips immediately (the PR 13 behavior)
+        "gate_promotions": True,
+        "promote_winrate": 0.55,
+        "promote_games": 16,
+        "shadow_fraction": 0.25,
+        # quality sentinel behind the gate: a PROMOTED snapshot whose
+        # live win-point EMA (window quality_window games) degrades more
+        # than demote_drop below the incumbent's bar is demoted
+        # serving-side and a verified rollback signal reaches training
+        "quality_window": 32,
+        "demote_drop": 0.15,
     },
     # --- observability plane (docs/observability.md) --------------------
     # structured span tracing (utils/trace.py): ring-buffered in-process
@@ -854,6 +903,13 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
                 f"train_args.fleet.replicas entry {entry!r} must be a "
                 "'host:port' string or a dict"
             )
+    if int(fleet["poll_retry_attempts"]) < 0:
+        raise ValueError(
+            "train_args.fleet.poll_retry_attempts must be >= 0 (0 = no "
+            "retry, the pre-flywheel fail-at-once behavior)"
+        )
+    if float(fleet["poll_retry_backoff_s"]) <= 0:
+        raise ValueError("train_args.fleet.poll_retry_backoff_s must be > 0")
     if float(fleet["stats_poll_s"]) <= 0:
         raise ValueError(
             "train_args.fleet.stats_poll_s must be > 0 (it feeds the load "
@@ -932,6 +988,48 @@ def validate_args(args: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError(
             "train_args.league.max_population must be >= 2 (the anchor "
             "plus at least one frozen member)"
+        )
+    fly = train["flywheel"]
+    if not isinstance(fly["enabled"], bool):
+        raise ValueError(
+            f"train_args.flywheel.enabled={fly['enabled']!r} must be a bool"
+        )
+    for key in ("harvest_fraction", "shadow_fraction"):
+        if not 0.0 <= float(fly[key]) <= 1.0:
+            raise ValueError(f"train_args.flywheel.{key} must be in [0, 1]")
+    if not 0.0 < float(fly["promote_winrate"]) < 1.0:
+        raise ValueError(
+            "train_args.flywheel.promote_winrate must be in (0, 1) — it is "
+            "a live win-points bar, not a guarantee"
+        )
+    if not 0.0 < float(fly["demote_drop"]) < 1.0:
+        raise ValueError(
+            "train_args.flywheel.demote_drop must be in (0, 1) — the live "
+            "win-point EMA drop that trips the quality sentinel"
+        )
+    if int(fly["staleness_epochs"]) < 1:
+        raise ValueError(
+            "train_args.flywheel.staleness_epochs must be >= 1 (0 would "
+            "drop every harvested episode as stale)"
+        )
+    for key in ("promote_games", "quality_window", "harvest_max_pull",
+                "harvest_max_open"):
+        if int(fly[key]) < 1:
+            raise ValueError(f"train_args.flywheel.{key} must be >= 1")
+    for key in ("harvest_poll_s", "harvest_ttl_s"):
+        if float(fly[key]) <= 0:
+            raise ValueError(f"train_args.flywheel.{key} must be > 0")
+    if not isinstance(fly["gate_promotions"], bool):
+        raise ValueError(
+            f"train_args.flywheel.gate_promotions="
+            f"{fly['gate_promotions']!r} must be a bool"
+        )
+    if not isinstance(fly["harvest_port"], int) or not (
+        0 <= fly["harvest_port"] <= 65535
+    ):
+        raise ValueError(
+            f"train_args.flywheel.harvest_port={fly['harvest_port']!r} must "
+            "be a TCP port in [0, 65535] (0 = follow serving.port)"
         )
     if int(train["autovec_verify_games"]) < 0:
         raise ValueError("train_args.autovec_verify_games must be >= 0 (0 = off)")

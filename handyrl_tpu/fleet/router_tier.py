@@ -65,6 +65,7 @@ import queue as _queue
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Dict, List, Optional
 
 from ..runtime.connection import (
@@ -75,6 +76,7 @@ from ..runtime.connection import (
 )
 from ..serving.client import ServingClient, ServingError
 from ..utils.metrics import append_metrics_record
+from ..utils.retry import retry_call
 from ..utils.trace import trace_event
 
 __all__ = ["FleetRouter", "ReplicaSpec", "fleet_main"]
@@ -181,6 +183,10 @@ class FleetRouter(QueueCommunicator):
         self.port = int(cfg.get("port", 9996))
         self.bound_port: Optional[int] = None
         self.stats_poll_s = float(cfg.get("stats_poll_s", 2.0))
+        # transient-fault budget for the stats poll (utils/retry.py): one
+        # flaky syscall must not cost a replica_lost + re-routing storm
+        self.poll_retry_attempts = int(cfg.get("poll_retry_attempts", 3))
+        self.poll_retry_backoff_s = float(cfg.get("poll_retry_backoff_s", 0.1))
         self.replica_stall_s = float(cfg.get("replica_stall_s", 30.0))
         self.backoff_s = float(cfg.get("rejoin_backoff_s", 1.0))
         self.backoff_max_s = float(cfg.get("rejoin_backoff_max_s", 30.0))
@@ -224,6 +230,7 @@ class FleetRouter(QueueCommunicator):
         self.last_migration_ms = 0.0
         self.failover_retries = 0
         self.preempt_drains = 0
+        self.poll_retries = 0
         self._stats_t0 = time.monotonic()
         self._stats_served0 = 0
         self._sock = None
@@ -343,6 +350,29 @@ class FleetRouter(QueueCommunicator):
             rep.parked = []
             rep.load = 0.0
 
+    def _replica_stats(self, rep: _Replica) -> Optional[Dict[str, Any]]:
+        """One replica's stats frame under the shared transient-fault
+        discipline (utils/retry.py): transport-shaped failures (reset,
+        EINTR, a missed reply deadline) retry with backoff inside the
+        ``poll_retry_attempts`` budget before the caller may declare the
+        peer lost.  A server-REPORTED failure (``ServingError``) is the
+        peer misbehaving, not flaking — it propagates immediately."""
+        client = rep.client
+        if client is None:
+            raise ConnectionError("replica has no client")
+
+        def _count(i, exc):
+            with self._stats_lock:
+                self.poll_retries += 1
+
+        return retry_call(
+            lambda: client.stats(timeout=max(self.stats_poll_s * 4, 10.0)),
+            attempts=self.poll_retry_attempts,
+            base_delay=self.poll_retry_backoff_s,
+            retry_on=(ConnectionError, OSError, TimeoutError, FuturesTimeout),
+            on_retry=_count,
+        )
+
     def _admit_loop(self, rep: _Replica) -> None:
         """Warm-then-admit probe: poll the replica's stats until its
         engine is published and warm (``serve_models`` >= 1; an edge
@@ -354,11 +384,10 @@ class FleetRouter(QueueCommunicator):
         deadline = time.monotonic() + warm_timeout
         poll = max(0.05, min(self.stats_poll_s, 0.5))
         while not self.shutdown_flag and rep.alive and not rep.sealed:
-            client = rep.client
-            if client is None:
+            if rep.client is None:
                 return
             try:
-                stats = client.stats(timeout=max(self.stats_poll_s * 4, 10.0))
+                stats = self._replica_stats(rep)
             except Exception:
                 self._mark_lost(rep)
                 return
@@ -454,11 +483,10 @@ class FleetRouter(QueueCommunicator):
                     self._ctl_pool.submit(self._poll_one, rep)
 
     def _poll_one(self, rep: _Replica) -> None:
-        client = rep.client
-        if client is None:
+        if rep.client is None:
             return
         try:
-            stats = client.stats(timeout=max(self.stats_poll_s * 4, 10.0))
+            stats = self._replica_stats(rep)
         except Exception:
             self._mark_lost(rep)
             return
@@ -906,6 +934,7 @@ class FleetRouter(QueueCommunicator):
             migration_ms = self.last_migration_ms
             retries = self.failover_retries
             preempts = self.preempt_drains
+            poll_retries = self.poll_retries
             dt = max(now - self._stats_t0, 1e-6)
             served_delta = replies - self._stats_served0
             if advance_window:
@@ -932,6 +961,7 @@ class FleetRouter(QueueCommunicator):
             "fleet_migration_ms": round(migration_ms, 2),
             "fleet_failover_retries": retries,
             "fleet_preempt_drains": preempts,
+            "fleet_poll_retries": poll_retries,
         }
         return record
 

@@ -1,0 +1,118 @@
+"""Quality-guarded data flywheel: served traffic back into training.
+
+The production loop before this package flowed one way — the manifest
+watcher hot-swapped training checkpoints into serving, and served
+episodes were discarded.  The flywheel closes the circle with guards at
+every seam:
+
+* ``harvest.py`` — the serving server assembles per-session transitions
+  into complete Generator-format episodes (shared ``finalize_episode``
+  recipe, bit-identical to self-play encoding) for the learner to pull;
+* ``quality.py`` — per-snapshot live win-rate ledger, the promotion gate
+  (a new checkpoint must beat ``flywheel.promote_winrate`` over
+  ``promote_games`` live games before ``latest`` flips), and the quality
+  sentinel (a promoted snapshot that regresses is demoted serving-side
+  and rolled back training-side);
+* ``ingest.py`` — the learner-side pull loop with staleness/shape/budget
+  guards feeding the standard ``feed_episodes`` path.
+
+:class:`FlywheelPlane` is the serving server's single attachment point:
+it owns the recorder and the controller, drives both from the server's
+existing watch loop, and answers the harvest wire frames.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .harvest import HarvestError, HarvestRecorder
+from .ingest import HarvestIngestor
+from .quality import (
+    ROLLBACK_FILE,
+    SERVING_FILE,
+    QualityController,
+    QualityLedger,
+    read_rollback_signal,
+    read_serving_state,
+    serving_pinned_epochs,
+    write_rollback_signal,
+    write_serving_state,
+)
+
+__all__ = [
+    "FlywheelPlane",
+    "HarvestError",
+    "HarvestRecorder",
+    "HarvestIngestor",
+    "QualityController",
+    "QualityLedger",
+    "ROLLBACK_FILE",
+    "SERVING_FILE",
+    "read_rollback_signal",
+    "write_rollback_signal",
+    "read_serving_state",
+    "write_serving_state",
+    "serving_pinned_epochs",
+]
+
+
+class FlywheelPlane:
+    """Everything the serving server needs, behind one object: harvest
+    episode assembly, shadow-slice routing, the promotion gate and the
+    quality sentinel.  Built by ``serve_main`` when ``flywheel.enabled``;
+    when absent the server behaves exactly as before."""
+
+    def __init__(self, router, model_dir: str, cfg: Dict[str, Any],
+                 gen_args: Dict[str, Any], obs_spec_fn=None):
+        self.cfg = dict(cfg)
+        self.recorder = HarvestRecorder(
+            gen_args,
+            max_open=int(cfg.get("harvest_max_open", 256)),
+            ttl_s=float(cfg.get("harvest_ttl_s", 600.0)),
+            obs_spec_fn=obs_spec_fn,
+        )
+        self.quality = QualityController(router, model_dir, cfg)
+        # deterministic shadow-slice accumulator (no RNG in the serve
+        # path): every request that targets "latest" adds the fraction;
+        # each time the accumulator crosses 1 one request shadows
+        self._shadow_acc = 0.0
+
+    # -- routing seam (server._do_infer) --------------------------------------
+
+    def shadow_model(self, model_id: Any) -> Any:
+        """Rewrite a latest-addressed request to the staged candidate for
+        the configured traffic slice.  Pinned (explicit-epoch), ensemble
+        and random requests pass through untouched — a client that pinned
+        its game to one epoch must never be shadow-mixed mid-game."""
+        if model_id not in (None, -1):
+            return model_id
+        candidate = self.quality.candidate_id()
+        fraction = self.quality.shadow_fraction
+        if candidate is None or fraction <= 0.0:
+            return model_id
+        self._shadow_acc += fraction
+        if self._shadow_acc >= 1.0:
+            self._shadow_acc -= 1.0
+            return candidate
+        return model_id
+
+    # -- capture seams (server._do_infer / _reply) ----------------------------
+
+    def capture_request(self, sid: Optional[str], obs: Any) -> None:
+        self.recorder.capture_request(sid, obs)
+
+    def capture_reply(self, sid: Optional[str], served: Any, out: Any) -> None:
+        self.recorder.capture_reply(sid, served, out)
+
+    # -- watch-loop beat -------------------------------------------------------
+
+    def tick(self) -> Optional[str]:
+        self.recorder.sweep()
+        return self.quality.tick()
+
+    # -- metrics ---------------------------------------------------------------
+
+    def stats_record(self) -> Dict[str, float]:
+        record: Dict[str, float] = dict(self.recorder.stats())
+        record.update(self.quality.stats_record())
+        return record

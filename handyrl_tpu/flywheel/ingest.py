@@ -1,0 +1,199 @@
+"""Learner-side harvest ingest: pull served-traffic episodes into the
+training rings.
+
+The ingestor is a daemon thread next to the learner's gateway: it polls
+the serving tier's ``harvest_pull`` endpoint, applies the learner-side
+quality guards, and submits surviving episodes through the learner's own
+request queue — so harvested episodes ride the exact same
+``feed_episodes`` path as self-play (EpisodeStore extend, generation
+books, epoch cadence), not a parallel one.
+
+Learner-side guards (the serving side already dropped malformed and
+truncated sessions):
+
+* **staleness** — an episode served by a snapshot ``staleness_epochs``
+  or more behind the CURRENT model epoch is off-policy garbage for the
+  importance weights; dropped and counted (``flywheel_ingest_stale``);
+* **shape** — a blob missing the episode contract (blocks/steps/args/
+  outcome) is counted ``flywheel_ingest_malformed`` and dropped loudly;
+* **budget** — with ``harvest_fraction < 1`` the ingestor submits at most
+  ``round(fraction * update_episodes)`` episodes per model epoch, leaving
+  the rest of the cadence to self-play; at 1.0 the feed is unthrottled
+  (self-play-free operation, the flagship e2e mode).
+
+Transport faults are survivable by design: the serving tier may start
+after the learner, restart, or drain — the poll loop reconnects with
+bounded backoff forever and only ever counts, never raises.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["HarvestIngestor"]
+
+_REQUIRED_KEYS = ("args", "steps", "players", "outcome", "blocks")
+
+
+class HarvestIngestor:
+    """Polls a serving endpoint for harvested episodes and feeds them to
+    the learner.
+
+    ``submit(episodes)`` delivers a batch into the learner (blocking until
+    accepted); ``current_epoch()`` reads the live model epoch for the
+    staleness bound; ``make_client()`` builds a connected pull client
+    exposing ``harvest_pull(max_episodes)`` and ``close()`` — injectable
+    so tests run socket-free."""
+
+    def __init__(
+        self,
+        cfg: Dict[str, Any],
+        submit: Callable[[List[Dict[str, Any]]], None],
+        current_epoch: Callable[[], int],
+        make_client: Callable[[], Any],
+    ):
+        self.staleness_epochs = max(1, int(cfg.get("staleness_epochs", 4)))
+        self.poll_s = float(cfg.get("harvest_poll_s", 1.0))
+        self.max_pull = max(1, int(cfg.get("harvest_max_pull", 64)))
+        fraction = float(cfg.get("harvest_fraction", 0.5))
+        update_episodes = int(cfg.get("update_episodes", 0))
+        # per-epoch submission budget; None = unthrottled (fraction 1.0
+        # or an owner that did not wire the cadence in)
+        self.epoch_budget: Optional[int] = (
+            None if fraction >= 1.0 or update_episodes <= 0
+            else max(0, round(fraction * update_episodes))
+        )
+        self._submit = submit
+        self._current_epoch = current_epoch
+        self._make_client = make_client
+        self._client: Any = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._budget_epoch = -1
+        self._budget_left = 0
+        # over-budget episodes wait here for the next epoch's budget; they
+        # re-enter through the staleness check, so a feed the mix never
+        # wants ages out instead of accumulating
+        self._deferred: List[Dict[str, Any]] = []
+        # books (folded into the learner's epoch record)
+        self.ingested = 0
+        self.dropped_stale = 0
+        self.dropped_malformed = 0
+        self.server_counts: Dict[str, int] = {}
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "HarvestIngestor":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="flywheel-ingest"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._close_client()
+
+    def _close_client(self) -> None:
+        client, self._client = self._client, None
+        if client is not None:
+            try:
+                client.close()
+            except Exception:
+                pass
+
+    # -- poll loop ------------------------------------------------------------
+
+    def _loop(self) -> None:
+        backoff = min(self.poll_s, 0.5)
+        while not self._stop.is_set():
+            try:
+                if self._client is None:
+                    self._client = self._make_client()
+                    backoff = min(self.poll_s, 0.5)
+                episodes, counts = self._client.harvest_pull(self.max_pull)
+                if counts:
+                    self.server_counts = dict(counts)
+                if episodes:
+                    self.ingest(episodes)
+                if self._stop.wait(self.poll_s):
+                    return
+            except (ConnectionError, OSError, TimeoutError):
+                # serving tier absent/draining: reconnect forever with
+                # bounded backoff — harvest starvation shows up as a flat
+                # flywheel_ingested counter, never as a learner crash
+                self._close_client()
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2.0, 10.0)
+            except Exception as exc:
+                print(f"flywheel: ingest poll failed: {exc}")
+                self._close_client()
+                if self._stop.wait(max(self.poll_s, 1.0)):
+                    return
+
+    # -- the guarded feed (separable for tests) -------------------------------
+
+    def ingest(self, episodes: List[Any]) -> int:
+        """Apply the learner-side guards and submit the survivors.
+        Returns the number submitted."""
+        current = int(self._current_epoch())
+        with self._lock:
+            deferred, self._deferred = self._deferred, []
+        fresh: List[Dict[str, Any]] = []
+        for episode in deferred + list(episodes):
+            if not isinstance(episode, dict) or any(
+                key not in episode for key in _REQUIRED_KEYS
+            ):
+                with self._lock:
+                    self.dropped_malformed += 1
+                print("flywheel: dropped malformed harvested blob "
+                      f"(keys {sorted(episode)[:8] if isinstance(episode, dict) else type(episode).__name__})")
+                continue
+            served = int(episode.get("model_epoch", 0))
+            if current - served >= self.staleness_epochs:
+                with self._lock:
+                    self.dropped_stale += 1
+                continue
+            fresh.append(episode)
+        if not fresh:
+            return 0
+        fresh = self._apply_budget(current, fresh)
+        if not fresh:
+            return 0
+        self._submit(fresh)
+        with self._lock:
+            self.ingested += len(fresh)
+        return len(fresh)
+
+    def _apply_budget(self, current: int, episodes: List[Dict[str, Any]],
+                      ) -> List[Dict[str, Any]]:
+        if self.epoch_budget is None:
+            return episodes
+        with self._lock:
+            if current != self._budget_epoch:
+                self._budget_epoch = current
+                self._budget_left = self.epoch_budget
+            take = max(0, min(self._budget_left, len(episodes)))
+            self._budget_left -= take
+            # bound the parking lot: beyond ~4 pulls of backlog the oldest
+            # entries are the next staleness casualties anyway
+            self._deferred = (self._deferred + episodes[take:])[-4 * self.max_pull:]
+        return episodes[:take]
+
+    # -- metrics --------------------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            record = {
+                "flywheel_ingested": self.ingested,
+                "flywheel_ingest_stale": self.dropped_stale,
+                "flywheel_ingest_malformed": self.dropped_malformed,
+            }
+            record.update(self.server_counts)
+        return record

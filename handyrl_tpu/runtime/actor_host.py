@@ -33,6 +33,7 @@ from typing import Any, Dict
 from ..envs import make_env, prepare_env
 from ..models import init_variables
 from ..utils import trace
+from ..utils.retry import retry_call
 
 # same convention as the learner's drain path (runtime/learner.py)
 EXIT_RESUMABLE = 75
@@ -105,6 +106,25 @@ def actor_host_main(args: Dict[str, Any]) -> None:
 
     stop = threading.Event()
 
+    def _reconnect(i, exc):
+        # one flaky syscall (EINTR, a reset mid-frame) must not cost an
+        # exit 75: drop the wedged connection, dial a fresh one, and let
+        # retry_call re-issue the SAME request.  A reconnect that itself
+        # fails propagates — that IS the gateway being gone, and the
+        # outer handler's announce_fault + exit 75 keeps its meaning
+        nonlocal client
+        print(
+            f"[handyrl_tpu] actor host {rank}: transient plane fault "
+            f"({exc}); reconnect attempt {i + 1}",
+            file=sys.stderr,
+        )
+        try:
+            client.close()
+        except Exception:
+            pass
+        client = PlaneClient(dist)
+        client.connect(retry_for=30.0)
+
     def _stop_signal(signum, frame):
         print(
             f"[handyrl_tpu] actor host {rank}: signal {signum} — draining",
@@ -130,12 +150,18 @@ def actor_host_main(args: Dict[str, Any]) -> None:
             )
             # graftlint: allow[HS001] reason=the record batch leaves this machine over DCN — host materialization is the transport's input, one D2H per k_steps block
             host_records = jax.device_get(records)
-            gateway_version = client.ship_records(host_records)
+            gateway_version = retry_call(
+                lambda: client.ship_records(host_records),
+                attempts=3, base_delay=0.1, on_retry=_reconnect,
+            )
             if gateway_version is None:
                 break  # clean stop from the gateway
             dispatches += 1
             if gateway_version > client.param_version:
-                got = client.poll_params()
+                got = retry_call(
+                    lambda: client.poll_params(),
+                    attempts=3, base_delay=0.1, on_retry=_reconnect,
+                )
                 if got is None:
                     break
                 new_version, fresh = got

@@ -47,6 +47,14 @@ Hooks:
   the trainer stops joining collectives, threads spin without progress
   (a wedged-but-not-dead host).  Survivors must escape through the
   heartbeat timeout or the collective watchdog, never hang.
+* ``HANDYRL_FAULT_POISON_SNAPSHOT_AT_EPOCH="E"`` — the learner SAVES a
+  sabotaged snapshot (negated params — digest-valid, loads cleanly,
+  plays terribly) at model epoch E while keeping its own in-memory
+  params clean.  The checkpoint plane cannot catch this: the file
+  verifies.  Only the flywheel's live quality plane can — the promotion
+  gate must refuse it (or the quality sentinel demote it) and signal a
+  training-side rollback.  Drives the bad-promotion e2e in
+  tests/test_flywheel.py.
 """
 
 from __future__ import annotations
@@ -129,6 +137,28 @@ def _epoch_rank(name: str) -> Optional[Tuple[int, int]]:
         raise ValueError(
             f"{name}={raw!r}: expected 'EPOCH' or 'EPOCH:RANK' (ints)"
         ) from None
+
+
+def poison_snapshot_epoch() -> Optional[int]:
+    """Model epoch at which the learner saves a sabotaged (negated-param)
+    snapshot, or None.  Malformed values raise immediately — a typo'd
+    injection silently doing nothing would fake a green promotion e2e."""
+    raw = _get("HANDYRL_FAULT_POISON_SNAPSHOT_AT_EPOCH")
+    if raw is None:
+        return None
+    try:
+        epoch = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"HANDYRL_FAULT_POISON_SNAPSHOT_AT_EPOCH={raw!r}: expected an "
+            "int model epoch"
+        ) from None
+    if epoch < 1:
+        raise ValueError(
+            f"HANDYRL_FAULT_POISON_SNAPSHOT_AT_EPOCH={raw!r}: epoch must "
+            "be >= 1"
+        )
+    return epoch
 
 
 def kill_process_at_epoch() -> Optional[Tuple[int, int]]:

@@ -24,6 +24,109 @@ from ..utils import softmax, tree_map, tree_stack
 from .replay import compress_block
 
 
+def stack_obs(obs_leaves):
+    """[[pytree per player] per step] -> pytree with (t, P, ...) leaves."""
+    return tree_stack([tree_stack(step) for step in obs_leaves])
+
+
+def finalize_episode(rows, players, outcome, args, gen_args, obs_spec_fn=None):
+    """Columnar-finalize per-step rows into a compressed-block episode.
+
+    This is THE episode recipe: the self-play Generator and the serving-
+    tier HarvestRecorder (flywheel/harvest.py) both finalize through this
+    one function, so a served session's episode is bit-identical to the
+    self-play encoding by construction — pinned by the flywheel parity
+    suite, never re-derived per caller.
+
+    ``rows`` are per-step dicts of per-player values (None = absent) with
+    keys obs/prob/amask/action/value/reward plus a scalar "turn" index.
+    ``gen_args`` supplies gamma / compress_steps / obs_int8; ``obs_spec_fn``
+    (obs_template -> per-leaf (scale, zero) spec) is required only when
+    obs_int8 is set.
+    """
+    P, T = len(players), len(rows)
+    gamma = gen_args["gamma"]
+
+    # discounted return-to-go per player (generation.py:78-82)
+    returns = np.zeros((T, P), np.float32)
+    for j, p in enumerate(players):
+        acc = 0.0
+        for t in range(T - 1, -1, -1):
+            acc = (rows[t]["reward"][p] or 0.0) + gamma * acc
+            returns[t, j] = acc
+
+    obs_template = tree_map(
+        np.zeros_like,
+        next(o for row in rows for o in row["obs"].values() if o is not None),
+    )
+    amask_template = np.full_like(
+        next(a for row in rows for a in row["amask"].values() if a is not None), 1e32
+    )
+
+    block_len = gen_args["compress_steps"]
+    blocks = []
+    for lo in range(0, T, block_len):
+        chunk = rows[lo : lo + block_len]
+        t = len(chunk)
+        cols = {
+            "prob": np.ones((t, P), np.float32),
+            "action": np.zeros((t, P), np.int32),
+            "amask": np.tile(amask_template, (t, P) + (1,) * amask_template.ndim),
+            "value": np.zeros((t, P), np.float32),
+            "reward": np.zeros((t, P), np.float32),
+            "ret": returns[lo : lo + t],
+            "tmask": np.zeros((t, P), np.float32),
+            "omask": np.zeros((t, P), np.float32),
+            "turn": np.asarray([row["turn"] for row in chunk], np.int32),
+        }
+        obs_leaves = []
+        for i, row in enumerate(chunk):
+            for j, p in enumerate(players):
+                if row["obs"][p] is not None:
+                    cols["omask"][i, j] = 1.0
+                if row["value"][p] is not None:
+                    cols["value"][i, j] = row["value"][p]
+                if row["reward"][p] is not None:
+                    cols["reward"][i, j] = row["reward"][p]
+                if row["prob"][p] is not None:
+                    cols["tmask"][i, j] = 1.0
+                    cols["prob"][i, j] = row["prob"][p]
+                    cols["action"][i, j] = row["action"][p]
+                    cols["amask"][i, j] = row["amask"][p]
+            obs_leaves.append(
+                [
+                    row["obs"][p] if row["obs"][p] is not None else obs_template
+                    for p in players
+                ]
+            )
+        cols["obs"] = stack_obs(obs_leaves)  # (t, P, ...) leaf-wise
+        if gen_args.get("obs_int8"):
+            # quantize ONCE at finalize: the compressed wire blocks,
+            # the shm ring slots, and the device replay rings all
+            # inherit the int8 leaves; dequantize runs on device at
+            # the consumption seams (models/quantize.py)
+            from ..models.quantize import quantize_obs_tree
+
+            cols["obs"] = quantize_obs_tree(cols["obs"], obs_spec_fn(obs_template))
+        blocks.append(compress_block(cols))
+
+    episode = {
+        "args": args,
+        "steps": T,
+        "players": players,
+        "outcome": outcome,
+        "blocks": blocks,
+    }
+    if gen_args.get("obs_int8"):
+        # the spec rides WITH the episode so every consumer (device
+        # stage, train step) dequantizes with the scales the data was
+        # actually quantized under — no env re-derivation stage-side
+        spec = obs_spec_fn(obs_template)
+        episode["obs_scale"] = np.asarray([s for s, _ in spec], np.float32)
+        episode["obs_zero"] = np.asarray([z for _, z in spec], np.float32)
+    return episode
+
+
 class Generator:
     def __init__(self, env, args: Dict[str, Any], on_step=None):
         self.env = env
@@ -120,94 +223,14 @@ class Generator:
         return self._finalize(rows, players, env.outcome(), args)
 
     def _finalize(self, rows, players, outcome, args) -> Dict[str, Any]:
-        P, T = len(players), len(rows)
-        gamma = self.args["gamma"]
-
-        # discounted return-to-go per player (generation.py:78-82)
-        returns = np.zeros((T, P), np.float32)
-        for j, p in enumerate(players):
-            acc = 0.0
-            for t in range(T - 1, -1, -1):
-                acc = (rows[t]["reward"][p] or 0.0) + gamma * acc
-                returns[t, j] = acc
-
-        obs_template = tree_map(
-            np.zeros_like,
-            next(o for row in rows for o in row["obs"].values() if o is not None),
+        return finalize_episode(
+            rows, players, outcome, args, self.args, obs_spec_fn=self._obs_quant_spec
         )
-        amask_template = np.full_like(
-            next(a for row in rows for a in row["amask"].values() if a is not None), 1e32
-        )
-
-        block_len = self.args["compress_steps"]
-        blocks = []
-        for lo in range(0, T, block_len):
-            chunk = rows[lo : lo + block_len]
-            t = len(chunk)
-            cols = {
-                "prob": np.ones((t, P), np.float32),
-                "action": np.zeros((t, P), np.int32),
-                "amask": np.tile(amask_template, (t, P) + (1,) * amask_template.ndim),
-                "value": np.zeros((t, P), np.float32),
-                "reward": np.zeros((t, P), np.float32),
-                "ret": returns[lo : lo + t],
-                "tmask": np.zeros((t, P), np.float32),
-                "omask": np.zeros((t, P), np.float32),
-                "turn": np.asarray([row["turn"] for row in chunk], np.int32),
-            }
-            obs_leaves = []
-            for i, row in enumerate(chunk):
-                for j, p in enumerate(players):
-                    if row["obs"][p] is not None:
-                        cols["omask"][i, j] = 1.0
-                    if row["value"][p] is not None:
-                        cols["value"][i, j] = row["value"][p]
-                    if row["reward"][p] is not None:
-                        cols["reward"][i, j] = row["reward"][p]
-                    if row["prob"][p] is not None:
-                        cols["tmask"][i, j] = 1.0
-                        cols["prob"][i, j] = row["prob"][p]
-                        cols["action"][i, j] = row["action"][p]
-                        cols["amask"][i, j] = row["amask"][p]
-                obs_leaves.append(
-                    [
-                        row["obs"][p] if row["obs"][p] is not None else obs_template
-                        for p in players
-                    ]
-                )
-            cols["obs"] = self._stack_obs(obs_leaves)  # (t, P, ...) leaf-wise
-            if self.args.get("obs_int8"):
-                # quantize ONCE at finalize: the compressed wire blocks,
-                # the shm ring slots, and the device replay rings all
-                # inherit the int8 leaves; dequantize runs on device at
-                # the consumption seams (models/quantize.py)
-                from ..models.quantize import quantize_obs_tree
-
-                cols["obs"] = quantize_obs_tree(
-                    cols["obs"], self._obs_quant_spec(obs_template)
-                )
-            blocks.append(compress_block(cols))
-
-        episode = {
-            "args": args,
-            "steps": T,
-            "players": players,
-            "outcome": outcome,
-            "blocks": blocks,
-        }
-        if self.args.get("obs_int8"):
-            # the spec rides WITH the episode so every consumer (device
-            # stage, train step) dequantizes with the scales the data was
-            # actually quantized under — no env re-derivation stage-side
-            spec = self._obs_quant_spec(obs_template)
-            episode["obs_scale"] = np.asarray([s for s, _ in spec], np.float32)
-            episode["obs_zero"] = np.asarray([z for _, z in spec], np.float32)
-        return episode
 
     @staticmethod
     def _stack_obs(obs_leaves):
         """[[pytree per player] per step] -> pytree with (t, P, ...) leaves."""
-        return tree_stack([tree_stack(step) for step in obs_leaves])
+        return stack_obs(obs_leaves)
 
     def execute(self, models, args):
         episode = self.generate(models, args)

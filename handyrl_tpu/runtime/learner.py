@@ -293,6 +293,25 @@ class Learner:
         else:
             self.worker = LocalWorkerPool(self.args, self.handle, self.model_server)
 
+        # -- data flywheel (handyrl_tpu/flywheel/) -------------------------
+        # learner side: the harvest ingest thread (started in run()) and
+        # the quality-plane rollback signal.  The seq baseline is read at
+        # startup so a stale FLYWHEEL_ROLLBACK.json from a previous run is
+        # never re-applied — only signals written AFTER this process came
+        # up count.
+        self._flywheel_cfg = dict(self.args.get("flywheel") or {})
+        self._flywheel_ingestor = None
+        self.flywheel_rollbacks = 0
+        self._flywheel_rollback_seq = 0
+        if self._flywheel_cfg.get("enabled"):
+            from ..flywheel import read_rollback_signal
+
+            sig = read_rollback_signal(self.model_dir)
+            self._flywheel_rollback_seq = int(sig.get("seq", 0)) if sig else 0
+        # HANDYRL_FAULT_POISON_SNAPSHOT_AT_EPOCH: sabotage one SAVED
+        # snapshot (update_model) while training continues on clean params
+        self._fault_poison_epoch = faults.poison_snapshot_epoch()
+
         self._requests: queue.Queue = queue.Queue()
         self._active_workers = 0
         self._shutdown_t0 = 0.0
@@ -553,6 +572,45 @@ class Learner:
         its frozen population members here."""
         return ()
 
+    def _gc_pin_set(self):
+        """The full pin set every gc_snapshots call site passes: the
+        subclass pins (league population) UNION the epochs the serving
+        tier reports it is routing (SERVING.json — latest, a staged
+        candidate, and the live incumbent).  A gated candidate can trail
+        ``keep_checkpoints`` behind while the serving plane still needs
+        its incumbent as the demote/rollback target; collecting it would
+        turn a quality demote into a restart-from-nothing."""
+        from ..flywheel.quality import serving_pinned_epochs
+
+        pins = set(self._gc_pinned())
+        pins |= serving_pinned_epochs(self.model_dir)
+        return tuple(sorted(pins))
+
+    def _flywheel_epoch(self, record: Dict[str, Any]) -> None:
+        """Epoch-boundary flywheel bookkeeping: fold the harvest-ingest
+        counters into the metrics record and consume any NEW quality-plane
+        rollback signal (seq-gated — each signal is applied exactly once)
+        by asking the trainer to roll back on its own thread."""
+        if not self._flywheel_cfg.get("enabled"):
+            return
+        if self._flywheel_ingestor is not None:
+            record.update(self._flywheel_ingestor.stats())
+        from ..flywheel import read_rollback_signal
+
+        sig = read_rollback_signal(self.model_dir)
+        seq = int(sig.get("seq", 0)) if sig else 0
+        if sig and seq > self._flywheel_rollback_seq:
+            self._flywheel_rollback_seq = seq
+            target = int(sig.get("target_epoch", 0))
+            print(
+                f"flywheel: serving tier flagged epoch "
+                f"{sig.get('bad_epoch')} ({sig.get('reason')}); requesting "
+                f"trainer rollback to verified epoch {target or 'newest'}"
+            )
+            self.trainer.request_rollback(target)
+            self.flywheel_rollbacks += 1
+        record["flywheel_rollbacks"] = self.flywheel_rollbacks
+
     # -- request plumbing ---------------------------------------------------
 
     def handle(self, req: str, data: Any, timeout: Optional[float] = None) -> Any:
@@ -769,6 +827,7 @@ class Learner:
         self._epoch_t0 = now
         self._epoch_steps0 = steps
         self._epoch_episodes0 = self.num_returned_episodes
+        self._flywheel_epoch(record)
         self._epoch_hook(record)
         self._write_metrics(record)
 
@@ -776,6 +835,20 @@ class Learner:
         print("updated model(%d)" % steps)
         self.model_epoch += 1
         self._dist_fault_hooks()
+        save_params = params
+        if self._fault_poison_epoch is not None \
+                and self.model_epoch == self._fault_poison_epoch:
+            # fault injection (runtime/faults.py): the SAVED snapshot is
+            # sabotaged — negated params are digest-valid and load cleanly,
+            # so only the flywheel's live quality gate can catch it.  The
+            # in-memory/published params stay clean: training is healthy,
+            # the artifact is the lie.
+            from ..utils import tree_map
+
+            print(f"[fault] poison_snapshot: epoch {self.model_epoch} "
+                  "snapshot saved with NEGATED params (training params "
+                  "stay clean)", flush=True)
+            save_params = tree_map(lambda x: -x, params)
         if is_coordinator():
             # process-0 guard: under jax.distributed every process runs the
             # SPMD train step, but exactly one owns the checkpoint files.
@@ -787,14 +860,14 @@ class Learner:
                 save_epoch_snapshot(
                     self.model_dir,
                     self.model_epoch,
-                    params,
+                    save_params,
                     self.trainer.save_payload(self.model_epoch),
                     steps,
                 )
                 gc_snapshots(
                     self.model_dir,
                     int(self.args.get("keep_checkpoints", 0)),
-                    pin=self._gc_pinned(),
+                    pin=self._gc_pin_set(),
                 )
         self.model_server.publish(self.model_epoch, params)
 
@@ -960,7 +1033,7 @@ class Learner:
         gc_snapshots(
             self.model_dir,
             int(self.args.get("keep_checkpoints", 0)),
-            pin=self._gc_pinned(),
+            pin=self._gc_pin_set(),
         )
         print(
             f"[handyrl_tpu] drain checkpoint: epoch {self.model_epoch} at "
@@ -1736,6 +1809,7 @@ class Learner:
                 threading.Thread(
                     target=self._watchdog_loop, daemon=True, name="plane-watchdog"
                 ).start()
+            self._start_flywheel_ingest()
             self.server()
             if self._plane_gateway is not None:
                 # run concluding: answer every further actor-host request
@@ -1752,6 +1826,8 @@ class Learner:
                     timeout = max(5.0, min(120.0, left))
                 self._rollout_thread.join(timeout=timeout)
         finally:
+            if self._flywheel_ingestor is not None:
+                self._flywheel_ingestor.stop()
             if self._health is not None:
                 self._health.stop()
             if self._collective_watchdog is not None:
@@ -1761,6 +1837,43 @@ class Learner:
             self._restore_signal_handlers()
             trace.shutdown()  # flush the span ring tail; a no-op when off
         return EXIT_RESUMABLE if self._drain_requested else 0
+
+    def _start_flywheel_ingest(self) -> None:
+        """Arm the harvest-ingest poll loop (flywheel/ingest.py) when the
+        flywheel is on and the mix wants served episodes.  Coordinator
+        only: harvested episodes enter through feed_episodes, and under
+        jax.distributed exactly one process drives the episode cadence."""
+        cfg = self._flywheel_cfg
+        if not cfg.get("enabled") or not is_coordinator():
+            return
+        if float(cfg.get("harvest_fraction", 0.5)) <= 0.0:
+            return
+        from ..flywheel import HarvestIngestor
+
+        host = str(cfg.get("harvest_host", "127.0.0.1"))
+        port = int(cfg.get("harvest_port", 0)) or int(
+            (self.args.get("serving") or {}).get("port", 9997)
+        )
+
+        def make_client():
+            from ..serving.client import ServingClient
+
+            return ServingClient(host, port, timeout=10.0)
+
+        def submit(episodes):
+            # ride the standard request queue: feed_episodes books the
+            # generation stats and drives the epoch cadence exactly as a
+            # worker's self-play batch would
+            self.handle("episode", episodes, timeout=60.0)
+
+        self._flywheel_ingestor = HarvestIngestor(
+            dict(cfg, update_episodes=self.args.get("update_episodes", 0)),
+            submit,
+            lambda: self.model_epoch,
+            make_client,
+        ).start()
+        print(f"flywheel: harvest ingest armed ({host}:{port}, "
+              f"fraction {cfg.get('harvest_fraction', 0.5)})")
 
     @property
     def shutdown_coherent(self) -> bool:

@@ -167,8 +167,20 @@ def _batcher_main(shm_name, spec, slot_bytes, args, local_batch, seed,
     reclamation bumps the generation, invalidating any message still in
     flight so a reclaimed slot can never circulate twice."""
     import random
+    import signal
 
     from . import replay
+
+    # fork copies the learner's SIGTERM/SIGINT drain handlers into this
+    # process, where they only flip flags on a dead copy of the learner —
+    # a terminate() from the parent would be swallowed and the child
+    # would survive its own teardown.  Restore the default disposition
+    # so this process stays killable.
+    for _sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(_sig, signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
 
     replay.reset_block_cache()
     random.seed((int(seed) & 0xFFFFFFFF) * 1_000_003 + os.getpid())
@@ -479,13 +491,22 @@ class ShmBatchPipeline:
         stamping ownership FIRST so the slot is attributed at every
         instant it is outside the parent's hands — a child killed at any
         point can have all its slots reclaimed."""
+        if self._closed or self.stop_event.is_set():
+            # teardown: close() may already have closed the free queues
+            # under the consumer thread retiring its in-flight slots —
+            # nothing will consume the slot again, parking it is enough
+            self._orphan_slots.append(slot)
+            return
         n = len(self._procs)
         for off in range(n):
             i = (self._deal_rr + off) % n
             if self._procs[i] is not None:
                 self._deal_rr = (i + 1) % n
                 self._owner[slot] = i
-                self._free_qs[i].put(slot)
+                try:
+                    self._free_qs[i].put(slot)
+                except (ValueError, OSError):  # closed under our feet
+                    self._orphan_slots.append(slot)
                 return
         # every child is currently dead (between death and respawn, or
         # headed for degradation): park the slot; respawn re-deals it
@@ -497,6 +518,13 @@ class ShmBatchPipeline:
         """Reap dead batcher children: reclaim their ring slots, respawn
         within budget, degrade to the thread pipeline past it.  Runs on
         the consumer thread only (throttled)."""
+        # never respawn during teardown: children exiting 0 after
+        # close() set mp_stop are a NORMAL stop, and a child forked here
+        # races close()'s procs snapshot — it would be neither joined nor
+        # terminated, and the interpreter's multiprocessing atexit join
+        # then hangs the learner's exit on it
+        if self.stop_event.is_set() or self._closed:
+            return
         now = time.monotonic()
         if now - self._last_child_check < 0.25 or self._fallback is not None:
             return

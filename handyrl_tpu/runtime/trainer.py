@@ -66,6 +66,9 @@ SENTINEL_EVENT_KEYS = (
     "sentinel_skipped_steps",
     "sentinel_spike_steps",
     "sentinel_rollbacks",
+    # rollbacks requested by the serving tier's quality sentinel
+    # (flywheel/quality.py signal -> Learner -> request_rollback)
+    "sentinel_flywheel_rollbacks",
 )
 
 
@@ -344,6 +347,11 @@ class Trainer:
         self._loss_ema: Optional[float] = None
         self._sentinel_streak = 0
         self.sentinel_events: Dict[str, int] = {k: 0 for k in SENTINEL_EVENT_KEYS}
+        # quality-plane rollback request (epoch, or None): SET from the
+        # learner's server thread (request_rollback), CONSUMED at the next
+        # train_epoch entry on the trainer's own thread — the state reset
+        # must never race an in-flight device step
+        self._requested_rollback: Optional[int] = None
         # env-driven injections (runtime/faults.py): NaN lr window and
         # self-SIGTERM, parsed here so tests set the env before construction
         self._fault_nan = faults.nan_window()
@@ -727,6 +735,23 @@ class Trainer:
             params = ckpt.load_verified_params(
                 model_dir, epoch, self.state_host["params"], pre_verified=True
             )
+        self.sentinel_events["sentinel_rollbacks"] += 1
+        self._reset_state_from(params)
+        print(
+            f"[sentinel] rolled back to verified epoch {epoch} after a "
+            f"divergence streak (step counter stays at {self.steps}; "
+            "fresh optimizer; re-seeded sampling RNG)",
+            file=sys.stderr,
+        )
+
+    def _reset_state_from(self, params) -> None:
+        """The shared rollback tail: rebuild the train state around
+        ``params`` with a fresh optimizer (the moments fed the problem),
+        the step counter kept MONOTONE (lr schedule, param-cache publish
+        versions and the host books all key off it), and the device-replay
+        sampling RNG jumped far from the stream that fed the poison.
+        Callers bump their event counter FIRST — the re-seed keys off the
+        total rollback count."""
         # init_state dispatches multi-device layout programs; mid-run the
         # rollout thread may be dispatching concurrently — init_state now
         # takes the learner mesh's locks per program itself (the locks are
@@ -738,22 +763,81 @@ class Trainer:
         self.state = state
         # graftlint: allow[HS001] reason=rollback is a rare recovery path; the host snapshot is what checkpoints/drains read
         self.state_host = jax.device_get(state)
-        self.sentinel_events["sentinel_rollbacks"] += 1
-        # jump the sampling stream far from the one that fed the poison
         self._replay_key = jax.random.PRNGKey(
             (self.args["seed"] ^ 0x7EA1)
-            + 0x9E3779B9 * self.sentinel_events["sentinel_rollbacks"]
+            + 0x9E3779B9 * (
+                self.sentinel_events["sentinel_rollbacks"]
+                + self.sentinel_events["sentinel_flywheel_rollbacks"]
+            )
             + self.steps
         )
+
+    # -- quality-plane rollback (flywheel/quality.py signal) ------------------
+
+    def request_rollback(self, epoch: int) -> None:
+        """Ask for a rollback to verified ``epoch`` (<= 0 = newest
+        verified).  Called from the learner's server thread when the
+        serving tier's quality sentinel signals a regressed snapshot; the
+        actual state reset happens at the next ``train_epoch`` entry on
+        the trainer's own thread, so it can never race a device step the
+        trainer is mid-way through dispatching."""
+        self._requested_rollback = int(epoch)
+
+    def _consume_requested_rollback(self) -> None:
+        requested = self._requested_rollback
+        if requested is None:
+            return
+        self._requested_rollback = None
+        from . import checkpoint as ckpt
+
+        if self.cadence is not None:
+            # the collective path needs every rank in the call together
+            # (agree + broadcast); a one-sided quality signal cannot drive
+            # it safely — the divergence sentinel's collective machinery
+            # remains the multi-process recovery story
+            print(
+                "[flywheel] quality rollback requested but a multi-process "
+                "cadence is active; skipping the one-sided reset",
+                file=sys.stderr,
+            )
+            return
+        model_dir = self.args.get("model_dir", "models")
+        try:
+            target = requested if requested > 0 else \
+                ckpt.latest_verified_epoch(model_dir)
+            if target <= 0:
+                print(
+                    "[flywheel] quality rollback requested but no verified "
+                    "snapshot exists; keeping current params",
+                    file=sys.stderr,
+                )
+                return
+            # full digest scan, not pre_verified: the signal names an epoch
+            # the SERVING tier trusted — this process has not verified it
+            params = ckpt.load_verified_params(
+                model_dir, target, self.state_host["params"]
+            )
+        except ckpt.CheckpointError as exc:
+            print(
+                f"[flywheel] quality rollback to epoch {requested} refused "
+                f"({exc}); keeping current params",
+                file=sys.stderr,
+            )
+            return
+        self._sentinel_streak = 0
+        self._loss_ema = None
+        self.sentinel_events["sentinel_flywheel_rollbacks"] += 1
+        self._reset_state_from(params)
         print(
-            f"[sentinel] rolled back to verified epoch {epoch} after a "
-            f"divergence streak (step counter stays at {self.steps}; "
-            "fresh optimizer; re-seeded sampling RNG)",
+            f"[flywheel] rolled back to verified epoch {target} on the "
+            f"serving tier's quality signal (step counter stays at "
+            f"{self.steps}; fresh optimizer; re-seeded sampling RNG)",
             file=sys.stderr,
         )
 
     def train_epoch(self) -> Any:
         """Train until the learner flags an epoch end; return param snapshot."""
+        self._consume_requested_rollback()
         batch_cnt, data_cnt = 0, 0
         metric_accum = []
         lr = self.lr

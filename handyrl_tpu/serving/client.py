@@ -225,6 +225,61 @@ class ServingClient:
             "sessions": sessions or {}, "fresh": list(fresh),
         }).result(timeout=timeout)
 
+    # -- data flywheel (docs/serving.md §Data flywheel) ----------------------
+
+    def harvest_open(self, players, sids, timeout: float = 30.0) -> str:
+        """Bind one game's per-player sessions into a harvest episode on
+        the server; returns the harvest id.  ``players``/``sids`` are
+        parallel lists — the server captures each sid's obs/policy/value
+        at its own infer seams from here on."""
+        reply = self._send("harvest_open", {
+            "players": list(players), "sids": list(sids),
+        }).result(timeout=timeout)
+        return reply["hid"]
+
+    def harvest_step(self, hid: str, actions, legal, rewards, turn,
+                     timeout: float = 30.0) -> int:
+        """Close one step with the client-side half: per-player sampled
+        actions (None for non-movers), legal-action lists, rewards, and
+        the turn player.  Call AFTER every acting player's infer reply
+        arrived — the reply is the capture receipt.  Returns the step
+        count so far."""
+        reply = self._send("harvest_step", {
+            "hid": hid, "actions": list(actions), "legal": list(legal),
+            "rewards": list(rewards), "turn": turn,
+        }).result(timeout=timeout)
+        return reply["steps"]
+
+    def harvest_close(self, hid: str, outcome, timeout: float = 60.0) -> bool:
+        """Finalize the episode with per-player outcomes (None = the game
+        was abandoned: the server counts a truncated drop).  Returns
+        whether the episode was kept."""
+        reply = self._send("harvest_close", {
+            "hid": hid,
+            "outcome": None if outcome is None else list(outcome),
+        }).result(timeout=timeout)
+        return reply["kept"]
+
+    def harvest_pull(self, max_episodes: int = 64,
+                     timeout: float = 60.0) -> Tuple[list, Dict[str, Any]]:
+        """Drain up to ``max_episodes`` completed harvest episodes
+        (ownership transfers) plus the server's harvest counters — the
+        learner ingest loop's poll."""
+        reply = self._send("harvest_pull", {
+            "max": int(max_episodes),
+        }).result(timeout=timeout)
+        return reply.get("episodes") or [], reply.get("counts") or {}
+
+    def report_outcome(self, model: int, outcome: float,
+                       timeout: float = 30.0) -> None:
+        """Book one finished game's outcome ([-1, 1]) against the epoch
+        that served it — the promotion gate / quality sentinel's feed.
+        Pin the game to one epoch (the first reply's served id) so the
+        attribution is honest."""
+        self._send("report_outcome", {
+            "model": int(model), "outcome": float(outcome),
+        }).result(timeout=timeout)
+
     def pending_count(self) -> int:
         """Requests in flight on this connection — the migration drain
         barrier (a retire exports only once this reaches zero)."""

@@ -199,6 +199,13 @@ class ModelRouter:
         self.hot_swaps = 0
         self.substituted = 0
         self.last_warm_ms: Optional[float] = None
+        # promotion-gate state (flywheel/quality.py): a STAGED candidate
+        # is resident and addressable but latest does not flip until the
+        # live-traffic verdict; after a promotion the displaced incumbent
+        # stays resident as the quality sentinel's demote target.  Both
+        # are exempt from LRU eviction while they hold these roles.
+        self._candidate_id: Optional[int] = None
+        self._incumbent_id: Optional[int] = None
 
     # -- engine construction / hot-swap --------------------------------------
 
@@ -233,6 +240,13 @@ class ModelRouter:
                 if prev is not None and prev != int(model_id):
                     self.hot_swaps += 1
                 self.last_warm_ms = warm_ms
+                # a direct publish supersedes any in-flight gate: the id
+                # just published stops being a candidate, and a newer
+                # latest obsoletes the previous promotion's incumbent pin
+                if self._candidate_id == int(model_id):
+                    self._candidate_id = None
+                if prev is not None and prev != int(model_id):
+                    self._incumbent_id = None
             stopped = self._stopped
         if stopped:  # raced shutdown: nothing may re-register
             engine.stop()
@@ -256,6 +270,104 @@ class ModelRouter:
         )
         self.publish(newest, params)
         return newest
+
+    # -- promotion gate (flywheel/quality.py drives these) --------------------
+
+    def candidate_id(self) -> Optional[int]:
+        with self._lock:
+            return self._candidate_id
+
+    def incumbent_id(self) -> Optional[int]:
+        with self._lock:
+            return self._incumbent_id
+
+    def stage(self, model_id: int, params, warm: bool = True) -> float:
+        """publish() minus the flip: build + warm an engine for
+        ``model_id`` and register it as the CANDIDATE route.  Latest-
+        addressed traffic keeps hitting the incumbent except for the
+        shadow slice the server explicitly rewrites; the candidate is
+        individually addressable by its epoch id."""
+        model = build_inference_model(self.module, params, self.weight_dtype)
+        engine = self._spawn(model)
+        warm_ms = engine.warm(self.warm_buckets, self._template_obs) if warm else 0.0
+        with self._lock:
+            if self._stopped:
+                displaced = None
+            else:
+                displaced = self._engines.pop(int(model_id), None)
+                if displaced is not None:
+                    self._draining.append(displaced)  # atomic with the pop
+                self._engines[int(model_id)] = engine
+                self._touched[int(model_id)] = time.monotonic()
+                self._candidate_id = int(model_id)
+                self.last_warm_ms = warm_ms
+            stopped = self._stopped
+        if stopped:  # raced shutdown: nothing may re-register
+            engine.stop()
+            raise RouteError("router stopped")
+        if displaced is not None:
+            self._retire(displaced)
+        self._evict_over_capacity()
+        return warm_ms
+
+    def promote_candidate(self) -> Optional[int]:
+        """Flip latest to the staged candidate (the gate cleared).  The
+        displaced incumbent STAYS resident as the sentinel's demote
+        target.  Returns the promoted id, or None without a candidate."""
+        with self._lock:
+            candidate = self._candidate_id
+            if candidate is None or candidate not in self._engines:
+                self._candidate_id = None
+                return None
+            prev = self._latest_id
+            self._latest_id = candidate
+            self._candidate_id = None
+            self._incumbent_id = prev if prev != candidate else None
+            self._touched[candidate] = time.monotonic()
+            if prev is not None and prev != candidate:
+                self.hot_swaps += 1
+        return candidate
+
+    def demote_candidate(self) -> Optional[int]:
+        """Drop the staged candidate (the gate failed): unregister and
+        retire its engine; latest never flipped, so traffic is untouched.
+        Returns the demoted id, or None without a candidate."""
+        with self._lock:
+            candidate = self._candidate_id
+            self._candidate_id = None
+            engine = None
+            if candidate is not None:
+                engine = self._engines.pop(candidate, None)
+                if engine is not None:
+                    self._draining.append(engine)  # atomic with the pop
+                self._touched.pop(candidate, None)
+        if engine is not None:
+            self._retire(engine)
+        return candidate
+
+    def demote_latest(self) -> Optional[int]:
+        """Quality sentinel verdict: flip latest BACK to the resident
+        incumbent and retire the regressed engine.  Returns the restored
+        incumbent id, or None when there is no resident incumbent (then
+        the bad latest keeps serving — a degraded model beats no model)."""
+        with self._lock:
+            incumbent = self._incumbent_id
+            if incumbent is None or incumbent not in self._engines:
+                return None
+            bad = self._latest_id
+            self._latest_id = incumbent
+            self._incumbent_id = None
+            self._touched[incumbent] = time.monotonic()
+            self.hot_swaps += 1
+            engine = None
+            if bad is not None and bad != incumbent:
+                engine = self._engines.pop(bad, None)
+                if engine is not None:
+                    self._draining.append(engine)  # atomic with the pop
+                self._touched.pop(bad, None)
+        if engine is not None:
+            self._retire(engine)
+        return incumbent
 
     def _maybe_calibrate(self, params) -> None:
         """Publish-time calibration for the int8 rung: replay stored
@@ -336,10 +448,13 @@ class ModelRouter:
         doomed: List[ContinuousBatcher] = []
         with self._lock:
             while len(self._engines) > self.max_models:
-                # LRU among the non-latest residents; the latest is pinned
+                # LRU among the non-latest residents; the latest is pinned,
+                # and so are a staged candidate (mid-gate) and a promoted
+                # snapshot's incumbent (the sentinel's demote target)
                 candidates = [
                     k for k in self._engines
                     if k != self._latest_id and k != protect
+                    and k != self._candidate_id and k != self._incumbent_id
                 ]
                 if not candidates:
                     break
@@ -386,6 +501,15 @@ class ModelRouter:
             latest = self._latest_id
             if latest is None:
                 raise RouteError("no model published yet")
+            # a staged candidate usually carries an id NEWER than latest;
+            # it must stay explicitly addressable (the shadow slice and
+            # pinned candidate games route by its epoch id) rather than
+            # collapsing into the newest-means-latest rule below
+            if mid == self._candidate_id:
+                engine = self._engines.get(mid)
+                if engine is not None:
+                    self._touched[mid] = time.monotonic()
+                    return mid, engine
             if mid < 0 or mid >= latest:
                 self._touched[latest] = time.monotonic()
                 return latest, self._engines[latest]

@@ -22,6 +22,16 @@ Wire protocol (codec frames, all request/reply pairs carry ``rid``):
     -> ("export_sessions", {"rid"})     <- ("sessions_export", {"rid", "sessions", "fresh", "count"})
     -> ("import_sessions", {"rid", "sessions", "fresh"?})
                                         <- ("sessions_imported", {"rid", "count"})
+    -> ("harvest_open",  {"rid", "players", "sids"})
+                                        <- ("harvest_opened", {"rid", "hid"})
+    -> ("harvest_step",  {"rid", "hid", "actions", "legal", "rewards", "turn"})
+                                        <- ("harvest_stepped", {"rid", "hid", "steps"})
+    -> ("harvest_close", {"rid", "hid", "outcome"})
+                                        <- ("harvest_closed", {"rid", "hid", "kept"})
+    -> ("harvest_pull",  {"rid", "max"})
+                                        <- ("harvest", {"rid", "episodes", "counts"})
+    -> ("report_outcome", {"rid", "model", "outcome"})
+                                        <- ("outcome_recorded", {"rid"})
     -> ("heartbeat", None)              (liveness only, never replied)
     <- ("draining", {"deadline_s"})     (rid-less notice, pushed to every peer)
 
@@ -76,6 +86,7 @@ class ServingServer(QueueCommunicator):
         router: ModelRouter,
         serving_cfg: Dict[str, Any],
         metrics_path: Optional[str] = None,
+        flywheel=None,
     ):
         cfg = dict(serving_cfg or {})
         recv_timeout = float(cfg.get("recv_timeout", 0.0)) or None
@@ -89,9 +100,19 @@ class ServingServer(QueueCommunicator):
             send_queue_size=max(256, int(cfg.get("queue_bound", 1024))),
         )
         self.router = router
+        # data flywheel (flywheel/__init__.py): harvest capture at the
+        # infer/reply seams, harvest_* wire frames, and the promotion
+        # gate replacing the bare manifest refresh in the watch loop.
+        # None = every flywheel seam compiles out to the old behavior.
+        self.flywheel = flywheel
         self.port = int(cfg.get("port", 9997))
         self.bound_port: Optional[int] = None
         self.watch_interval = float(cfg.get("watch_interval", 0.0))
+        if flywheel is not None and self.watch_interval <= 0:
+            # the gate/sentinel live in the watch loop — a flywheel server
+            # without a watcher would stage candidates never and judge
+            # nothing, so default the beat on rather than silently stall
+            self.watch_interval = 1.0
         self.stats_interval = float(cfg.get("stats_interval", 30.0))
         self._default_slo_s = float(cfg.get("slo_ms", 200.0)) / 1000.0
         self._sheds = cfg.get("shed_policy", "deadline") != "none"
@@ -218,6 +239,20 @@ class ServingServer(QueueCommunicator):
                 elif req == "import_sessions":
                     self._cold_pool.submit(self._handle_import_sessions,
                                            conn, rid, data)
+                elif req in ("harvest_open", "harvest_step",
+                             "harvest_close", "harvest_pull",
+                             "report_outcome"):
+                    if self.flywheel is None:
+                        self._error(conn, rid, "bad_request",
+                                    "flywheel disabled (flywheel.enabled: false)")
+                    elif req in ("harvest_close", "harvest_pull"):
+                        # close finalizes + zlib-compresses a whole
+                        # trajectory; pull serializes a batch of blobs —
+                        # both off the dispatch thread
+                        self._cold_pool.submit(self._handle_harvest,
+                                               conn, rid, req, data)
+                    else:
+                        self._handle_harvest(conn, rid, req, data)
                 else:
                     self._error(conn, rid, "bad_request",
                                 f"unknown request {req!r}")
@@ -315,6 +350,49 @@ class ServingServer(QueueCommunicator):
         except Exception as exc:  # a pool task must never die silently
             self._error(conn, rid, "error", f"{type(exc).__name__}: {exc}")
 
+    def _handle_harvest(self, conn: FramedConnection, rid, req: str,
+                        data: Dict[str, Any]) -> None:
+        """Data-flywheel wire frames (docs/serving.md §Data flywheel).
+        The client reports the half of each step only it knows (sampled
+        action, legal set, rewards, turn, final outcome); the recorder
+        already captured the server half at the infer/reply seams."""
+        from ..flywheel import HarvestError
+
+        recorder = self.flywheel.recorder
+        try:
+            if req == "harvest_open":
+                hid = recorder.open_episode(
+                    data.get("players") or (), data.get("sids") or ()
+                )
+                self.send(conn, ("harvest_opened", {"rid": rid, "hid": hid}))
+            elif req == "harvest_step":
+                steps = recorder.step(
+                    data.get("hid"), data.get("actions") or (),
+                    data.get("legal") or (), data.get("rewards") or (),
+                    data.get("turn"),
+                )
+                self.send(conn, ("harvest_stepped",
+                                 {"rid": rid, "hid": data.get("hid"),
+                                  "steps": steps}))
+            elif req == "harvest_close":
+                episode = recorder.close(data.get("hid"), data.get("outcome"))
+                self.send(conn, ("harvest_closed",
+                                 {"rid": rid, "hid": data.get("hid"),
+                                  "kept": episode is not None}))
+            elif req == "harvest_pull":
+                episodes, counts = recorder.pull(int(data.get("max", 64)))
+                self.send(conn, ("harvest", {"rid": rid, "episodes": episodes,
+                                             "counts": counts}))
+            else:  # report_outcome
+                self.flywheel.quality.record_outcome(
+                    data.get("model"), data.get("outcome")
+                )
+                self.send(conn, ("outcome_recorded", {"rid": rid}))
+        except (HarvestError, ValueError) as exc:
+            self._error(conn, rid, "bad_request", str(exc))
+        except Exception as exc:  # a pool task must never die silently
+            self._error(conn, rid, "error", f"{type(exc).__name__}: {exc}")
+
     def begin_drain(self, deadline_s: float = 60.0) -> bool:
         """Preemption handoff (SIGTERM path, docs/fault_tolerance.md):
         push a rid-less ``draining`` notice to every peer, then wait for
@@ -340,6 +418,12 @@ class ServingServer(QueueCommunicator):
                   allow_cold: bool = True) -> None:
         rid = data.get("rid")
         model_id = data.get("model", -1)
+        if self.flywheel is not None:
+            # shadow slice: a latest-addressed request may be rewritten to
+            # the staged candidate (explicit/pinned ids pass untouched —
+            # the reply's served id tells the client which epoch answered,
+            # and harvest clients pin their whole game to that id)
+            model_id = self.flywheel.shadow_model(model_id)
         # the deadline is based at frame ARRIVAL for the default budget
         # too, not just explicit slo_ms — otherwise a cold-routed request's
         # wait behind a snapshot load would never count against it (the
@@ -367,6 +451,11 @@ class ServingServer(QueueCommunicator):
             # replica) falls back to the model's initial state and is
             # counted — the client keeps playing, degraded loudly in stats
             hidden, _status = self.sessions.lookup(sid)
+        if self.flywheel is not None and sid is not None:
+            # harvest capture, request half: the observation for this
+            # session's player (no-op unless the sid is bound to an open
+            # harvest episode)
+            self.flywheel.capture_request(sid, data.get("obs"))
         for attempt in (0, 1):
             try:
                 served, route = self.router.resolve(model_id, allow_cold=allow_cold)
@@ -423,6 +512,12 @@ class ServingServer(QueueCommunicator):
                       "replies — raising SIGTERM")
                 os.kill(os.getpid(), signal.SIGTERM)
             out = fut.result()
+            if self.flywheel is not None and sid is not None:
+                # harvest capture, reply half: the policy/value this epoch
+                # produced — BEFORE the reply frame leaves, so a client
+                # that waits for its reply can close the step knowing the
+                # capture is already on the books
+                self.flywheel.capture_reply(sid, served, out)
             if sid is not None and isinstance(out, dict) and "hidden" in out:
                 # the session's whole point: the next-step state stays
                 # here (store() re-pins it device-side) and the reply
@@ -466,6 +561,14 @@ class ServingServer(QueueCommunicator):
             if self.shutdown_flag:
                 return
             try:
+                if self.flywheel is not None:
+                    # the flywheel beat subsumes the bare refresh: with
+                    # gating off it IS maybe_refresh, with gating on it
+                    # stages/judges candidates and runs the sentinel
+                    event = self.flywheel.tick()
+                    if event is not None:
+                        print(f"serving: flywheel: {event}")
+                    continue
                 published = self.router.maybe_refresh()
                 if published is not None:
                     print(f"serving: hot-swapped to verified snapshot {published}")
@@ -513,6 +616,10 @@ class ServingServer(QueueCommunicator):
         }
         if self.sessions is not None:
             record.update(self.sessions.stats())
+        if self.flywheel is not None:
+            # flywheel_* harvest counters + quality_* gate/sentinel books
+            # (quality_wp{epoch} rides the registered prefix family)
+            record.update(self.flywheel.stats_record())
         if getattr(self.router, "weight_dtype", "float32") != "float32":
             # low-precision rung: dtype pin + the publish-time MEASURED
             # calibration record (None until a calibration_source is wired
@@ -583,8 +690,33 @@ def serve_main(args: Dict[str, Any]) -> None:
         # current check able to pick up training's very first epoch
         router.publish(0, init_variables(module, env)["params"])
 
+    flywheel = None
+    fly_cfg = train.get("flywheel", {}) or {}
+    if fly_cfg.get("enabled"):
+        from ..flywheel import FlywheelPlane
+
+        obs_spec_fn = None
+        if train.get("obs_int8"):
+            # harvested episodes must quantize under the SAME env spec the
+            # self-play Generator uses, or ring ingest would mix scales
+            from ..models.quantize import obs_quant_spec
+
+            obs_spec_fn = lambda obs: obs_quant_spec(env, obs=obs)
+        gen_args = {
+            "gamma": train.get("gamma", 0.8),
+            "compress_steps": train.get("compress_steps", 8),
+            "observation": train.get("observation", True),
+            "obs_int8": bool(train.get("obs_int8", False)),
+        }
+        flywheel = FlywheelPlane(
+            router, model_dir, fly_cfg, gen_args, obs_spec_fn=obs_spec_fn
+        )
+        print(f"serving: data flywheel on (gate_promotions="
+              f"{bool(fly_cfg.get('gate_promotions', True))})")
+
     server = ServingServer(
-        router, train.get("serving", {}), metrics_path=train.get("metrics_path")
+        router, train.get("serving", {}),
+        metrics_path=train.get("metrics_path"), flywheel=flywheel,
     ).run()
     print(f"serving: listening on port {server.bound_port} "
           f"(model {router.latest_id()}, dir {model_dir!r})", flush=True)

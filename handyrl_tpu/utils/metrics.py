@@ -69,6 +69,30 @@ METRIC_KEYS = frozenset({
     "fleet_scale_ups", "fleet_scale_downs", "fleet_migrations",
     "fleet_sessions_migrated", "fleet_migration_ms",
     "fleet_failover_retries", "fleet_preempt_drains",
+    # transient-fault retries the stats poll absorbed before anything was
+    # declared lost (utils/retry.py) — a rising count with zero
+    # fleet_replica_lost is the retry plane doing its job
+    "fleet_poll_retries",
+    # data flywheel, serving side (handyrl_tpu/flywheel/harvest.py folded
+    # into the ServingServer's periodic record): per-session episode
+    # assembly volume and the LOUD drop counters (malformed = protocol
+    # breakage, truncated = abandoned/TTL'd/shed games), plus the pull
+    # drain the learner ingest loop drives
+    "flywheel_episodes", "flywheel_open", "flywheel_queued",
+    "flywheel_dropped_malformed", "flywheel_dropped_truncated",
+    "flywheel_pulled",
+    # data flywheel, quality plane (handyrl_tpu/flywheel/quality.py):
+    # gated promotions / gate refusals / sentinel demotions (cumulative),
+    # live games booked, and the current candidate/incumbent epoch gauges
+    # (null when none is staged / retained)
+    "quality_promotions", "quality_gate_failures", "quality_demotions",
+    "quality_games", "quality_candidate", "quality_incumbent",
+    # data flywheel, learner side (handyrl_tpu/flywheel/ingest.py folded
+    # into the per-epoch record): episodes fed into the EpisodeStore,
+    # staleness/malformed drops at ingest, and quality-signal rollbacks
+    # applied by the trainer
+    "flywheel_ingested", "flywheel_ingest_stale",
+    "flywheel_ingest_malformed", "flywheel_rollbacks",
     # league plane (handyrl_tpu/league): per-epoch population health from
     # LeagueLearner._epoch_hook — exact keys, like serve_*, so every new
     # league stat is reviewed here.  league_matches/forfeits/promotions
@@ -109,8 +133,12 @@ METRIC_KEYS = frozenset({
 # over health-plane heartbeats (HostHealthPlane.rank_aggregates — min/
 # max/mean of epoch, steps, step rate, input_wait_frac, plus report
 # staleness); trace_*: cumulative tracer health (spans recorded, ring
-# drops) from utils/trace.trace_stats
-METRIC_KEY_PREFIXES = ("pipe_", "plane_", "sentinel_", "rank_", "trace_")
+# drops) from utils/trace.trace_stats; quality_wp*: the flywheel quality
+# ledger's per-snapshot live win-point family (quality_wp{epoch} — one
+# gauge per epoch with reported games, from QualityLedger.snapshot)
+METRIC_KEY_PREFIXES = (
+    "pipe_", "plane_", "sentinel_", "rank_", "trace_", "quality_wp",
+)
 
 
 def append_metrics_record(path: str, record: Dict[str, Any]) -> None:

@@ -1,0 +1,57 @@
+"""Bounded retry with exponential backoff for transient transport faults.
+
+One EINTR/ECONNRESET on a control-plane call (the actor host's record
+ship, the fleet router's stats poll) must not cost an exit-75 or a
+``replica_lost``: those are the responses to a PEER being gone, not to a
+single flaky syscall.  ``retry_call`` is the shared discipline — bounded
+attempts, exponential backoff, an ``on_retry`` hook for callers that
+must re-establish state (reconnect a client) between attempts — and it
+is deliberately injectable (``sleep``) so the retry schedule is pinned
+socket-free in tests.
+
+Only the listed ``retry_on`` exception types are retried; anything else
+propagates immediately (a protocol error is not transient).  The final
+failing exception propagates unchanged, so callers' existing peer-lost
+handling (announce_fault + exit 75, ``_mark_lost``) keeps its meaning:
+it now fires only after the bounded budget is spent.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+__all__ = ["retry_call"]
+
+T = TypeVar("T")
+
+
+def retry_call(
+    fn: Callable[[], T],
+    *,
+    attempts: int = 3,
+    base_delay: float = 0.1,
+    factor: float = 2.0,
+    max_delay: float = 2.0,
+    retry_on: Tuple[Type[BaseException], ...] = (ConnectionError, OSError, TimeoutError),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Call ``fn`` with up to ``attempts`` RETRIES after the first try
+    (``attempts=0`` means one try, no retry).  Backoff before retry ``i``
+    (0-based) is ``min(base_delay * factor**i, max_delay)``.
+
+    ``on_retry(i, exc)`` runs after the backoff sleep and before the next
+    attempt — the reconnect seam.  An exception it raises propagates (a
+    failed reconnect IS the peer being gone, not a transient)."""
+    attempts = max(0, int(attempts))
+    for i in range(attempts + 1):
+        try:
+            return fn()
+        except retry_on as exc:
+            if i >= attempts:
+                raise
+            sleep(min(max_delay, base_delay * (factor ** i)))
+            if on_retry is not None:
+                on_retry(i, exc)
+    raise AssertionError("unreachable")  # pragma: no cover

@@ -249,6 +249,35 @@ def test_gc_never_collects_the_newest_verified_rollback_target(tmp_path):
     assert cp.gc_snapshots(d2, 2) == [1, 2, 3]
 
 
+def test_gc_never_collects_epochs_the_serving_tier_is_routing(tmp_path):
+    """GC x flywheel interplay (the serving analogue of the rollback-target
+    pin above): SERVING.json publishes which epochs the serving tier is
+    ROUTING (latest / staged candidate / displaced incumbent), and the
+    learner's GC pass pins them — collecting the incumbent would turn a
+    quality demote into a cold resurrection-from-nothing, and collecting a
+    staged candidate would fail its promotion mid-gate."""
+    from handyrl_tpu.flywheel import serving_pinned_epochs, write_serving_state
+
+    d = str(tmp_path)
+    _seed_snapshots(d, epochs=(1, 2, 3, 4, 5, 6, 7))
+    # the serving tier routes latest=7 with candidate 2 staged and
+    # incumbent 1 retained — both far outside the keep=2 window
+    write_serving_state(d, latest=7, candidate=2, incumbent=1)
+    pins = serving_pinned_epochs(d)
+    assert pins == {7, 2, 1}
+    removed = cp.gc_snapshots(d, 2, pin=pins)
+    assert removed == [3, 4, 5]
+    for e in (1, 2, 6, 7):
+        assert os.path.exists(cp.model_path(d, e))
+    # the incumbent (the sentinel's demote/rollback target) still loads
+    # verified after the GC pass
+    np.testing.assert_array_equal(
+        cp.load_verified_params(d, 1, _params(0.0))["w"], _params(1.0)["w"]
+    )
+    # no state file / torn state degrades to the empty pin set, never raises
+    assert serving_pinned_epochs(str(tmp_path / "absent")) == set()
+
+
 def test_resume_roundtrip_preserves_adam_moments_and_steps(tmp_path):
     """The trainer contract behind every resume test: params + Adam
     moments + step count + lr EMA round-trip bit-exactly through the

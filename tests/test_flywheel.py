@@ -1,0 +1,1039 @@
+"""Data-flywheel tests (marker: flywheel) — the quality-guarded
+production loop (docs/serving.md §Data flywheel).
+
+Three layers of acceptance:
+
+* **Parity** — a served session assembled by the HarvestRecorder must be
+  bit-identical (same zlib block bytes, through the wire codec) to the
+  episode the self-play Generator builds for the SAME trajectory, and
+  ring ingest of harvested blobs must match ``make_batch`` key by key
+  (the ISSUE 6 parity style).  Both paths finalize through the one
+  shared ``finalize_episode`` recipe, so any difference is an assembly
+  bug, not sampling noise.
+
+* **Guards** — staleness-drop / malformed-session-drop units on both
+  sides of the wire (server HarvestRecorder, learner HarvestIngestor),
+  the promotion gate + quality sentinel on a stub router, and the
+  shared transient-fault retry discipline (actor-host reconnect shape,
+  fleet stats-poll hardening) — all socket-free.
+
+* **Flagship e2e** (slow) — a ``--serve`` + ``--train`` pair improves
+  measured win rate against scripted clients using ONLY served-traffic
+  episodes (zero self-play workers, ``harvest_fraction: 1.0``), with at
+  least one gated promotion recorded, and one deliberately-poisoned
+  snapshot (``HANDYRL_FAULT_POISON_SNAPSHOT_AT_EPOCH``) auto-demoted on
+  the serving side + rolled back on the training side, finishing with
+  finite loss and the incumbent bit-identically restored.
+"""
+
+import json
+import random
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.config import normalize_args
+from handyrl_tpu.envs import make_env
+from handyrl_tpu.flywheel.harvest import HarvestError, HarvestRecorder
+from handyrl_tpu.flywheel.ingest import HarvestIngestor
+from handyrl_tpu.flywheel.quality import (
+    QualityController,
+    QualityLedger,
+    read_rollback_signal,
+    serving_pinned_epochs,
+    write_rollback_signal,
+    write_serving_state,
+)
+from handyrl_tpu.runtime import codec
+from handyrl_tpu.runtime.batch import make_batch
+from handyrl_tpu.runtime.checkpoint import save_epoch_snapshot
+from handyrl_tpu.runtime.generation import Generator
+from handyrl_tpu.runtime.replay import EpisodeStore
+from handyrl_tpu.utils import softmax
+from handyrl_tpu.utils.retry import retry_call
+
+pytestmark = pytest.mark.flywheel
+
+
+def _targs(**over):
+    base = {"mesh": {"dp": 1}}
+    base.update(over)
+    cfg = normalize_args({"env_args": {"env": "TicTacToe"}, "train_args": base})
+    args = dict(cfg["train_args"])
+    args["env"] = cfg["env_args"]
+    return args
+
+
+def _gen_args(targs=None):
+    """The finalize-relevant subset serve_main hands the recorder."""
+    targs = targs or _targs()
+    return {
+        "gamma": targs["gamma"],
+        "compress_steps": targs["compress_steps"],
+        "observation": targs["observation"],
+        "obs_int8": bool(targs.get("obs_int8", False)),
+    }
+
+
+class _DetModel:
+    """Deterministic fixed-weight policy/value head: pure function of the
+    observation, so the self-play and harvest paths see byte-identical
+    outputs for the same trajectory."""
+
+    def __init__(self, seed=7):
+        rng = np.random.RandomState(seed)
+        self.W = rng.randn(27, 9).astype(np.float32)
+
+    def inference(self, obs, hidden=None):
+        flat = np.asarray(obs, np.float32).reshape(-1)
+        logits = np.tanh(flat @ self.W).astype(np.float32)
+        value = np.asarray([np.tanh(float(flat.sum()))], np.float32)
+        return {"policy": logits, "value": value, "hidden": None}
+
+    def init_hidden(self):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# parity: served session == self-play episode, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def _selfplay_episode(seed, targs, model_id=7):
+    env = make_env({"env": "TicTacToe"})
+    model = _DetModel()
+    players = env.players()
+    random.seed(seed)
+    return Generator(env, targs).generate(
+        {p: model for p in players},
+        {"player": players, "model_id": {p: model_id for p in players}},
+    )
+
+
+def _harvest_episode(seed, targs, served=7, recorder=None):
+    """The SAME trajectory re-played through the serving-side capture
+    seams (capture_request/capture_reply/step/close) — identical random
+    stream, identical deterministic model, so the recorder sees exactly
+    the requests a scripted client would have made."""
+    env = make_env({"env": "TicTacToe"})
+    model = _DetModel()
+    rec = recorder or HarvestRecorder(_gen_args(targs))
+    players = env.players()
+    sids = {p: f"parity-s{p}" for p in players}
+    hid = rec.open_episode(players, [sids[p] for p in players])
+    random.seed(seed)
+    env.reset()
+    while not env.terminal():
+        turn_players = env.turns()
+        actions = [None] * len(players)
+        legal_lists = [None] * len(players)
+        moves = {}
+        for p in turn_players:
+            j = players.index(p)
+            obs = env.observation(p)
+            rec.capture_request(sids[p], obs)
+            out = model.inference(obs)
+            rec.capture_reply(
+                sids[p], served, {"policy": out["policy"], "value": out["value"]}
+            )
+            logits = np.asarray(out["policy"], np.float32)
+            legal = env.legal_actions(p)
+            amask = np.full_like(logits, 1e32)
+            amask[legal] = 0.0
+            probs = softmax(logits - amask)
+            action = random.choices(legal, weights=probs[legal])[0]
+            actions[j] = int(action)
+            legal_lists[j] = list(legal)
+            moves[p] = action
+        turn = turn_players[0] if turn_players else None
+        env.step(moves)
+        reward = env.reward()
+        rec.step(hid, actions, legal_lists, [reward.get(p) for p in players], turn)
+    outcome = env.outcome()
+    return rec.close(hid, [float(outcome.get(p, 0.0)) for p in players])
+
+
+@pytest.mark.parametrize("seed", [11, 23, 37])
+def test_harvested_episode_bit_identical_to_selfplay(seed):
+    targs = _targs()
+    ep_self = _selfplay_episode(seed, targs)
+    ep_harv = _harvest_episode(seed, targs)
+    assert ep_self is not None and ep_harv is not None
+
+    assert ep_harv["steps"] == ep_self["steps"]
+    assert ep_harv["players"] == ep_self["players"]
+    assert ep_harv["outcome"] == ep_self["outcome"]
+    # THE bit-identity claim: the compressed column blocks are the same
+    # bytes — same obs, probs, amasks, actions, values, returns, masks
+    assert ep_harv["blocks"] == ep_self["blocks"]
+
+    # ... and they stay the same bytes through the wire codec the
+    # harvest_pull endpoint ships them over
+    wire = codec.loads(codec.dumps(ep_harv))
+    assert wire["blocks"] == ep_self["blocks"]
+    assert wire["steps"] == ep_self["steps"]
+
+    # harvest provenance stamps (never part of the block bytes)
+    assert ep_harv["args"]["harvest"] is True
+    assert ep_harv["model_epoch"] == 7
+    assert ep_harv["args"]["model_id"] == {p: 7 for p in ep_self["players"]}
+
+
+def test_harvest_ring_ingest_matches_make_batch(monkeypatch):
+    """Harvested blobs through EpisodeStore -> sample_window -> make_batch
+    must equal the self-play path key by key (ISSUE 6 parity style)."""
+    import jax
+
+    targs = _targs(batch_size=4, forward_steps=4, burn_in_steps=0)
+    seeds = (101, 202, 303)
+    eps_self = [_selfplay_episode(s, targs) for s in seeds]
+    eps_harv = [_harvest_episode(s, targs) for s in seeds]
+    store_s, store_h = EpisodeStore(64), EpisodeStore(64)
+    store_s.extend(eps_self)
+    store_h.extend(eps_harv)
+
+    fwd, burn, cs = (
+        targs["forward_steps"], targs["burn_in_steps"], targs["compress_steps"]
+    )
+    random.seed(9)
+    win_s = [store_s.sample_window(fwd, burn, cs) for _ in range(8)]
+    random.seed(9)
+    win_h = [store_h.sample_window(fwd, burn, cs) for _ in range(8)]
+    assert all(w is not None for w in win_s + win_h)
+
+    monkeypatch.setattr(
+        "handyrl_tpu.runtime.batch.random.randrange", lambda n: 0
+    )
+    batch_s = make_batch(win_s, targs)
+    batch_h = make_batch(win_h, targs)
+
+    assert set(batch_s) == set(batch_h)
+    for key in batch_s:
+        leaves_s = jax.tree.leaves(batch_s[key])
+        leaves_h = jax.tree.leaves(batch_h[key])
+        assert len(leaves_s) == len(leaves_h), key
+        for ls, lh in zip(leaves_s, leaves_h):
+            np.testing.assert_array_equal(
+                np.asarray(lh), np.asarray(ls), err_msg=key
+            )
+
+
+# ---------------------------------------------------------------------------
+# HarvestRecorder guards (server side)
+# ---------------------------------------------------------------------------
+
+
+def _open_pair(rec):
+    return rec.open_episode([0, 1], ["sa", "sb"])
+
+
+def _valid_row(rec, hid, sid="sa", player_slot=0, n_players=2):
+    obs = np.zeros((3, 3, 3), np.float32)
+    rec.capture_request(sid, obs)
+    rec.capture_reply(
+        sid, 3,
+        {"policy": np.zeros(9, np.float32), "value": np.asarray([0.1], np.float32)},
+    )
+    actions = [None] * n_players
+    legal = [None] * n_players
+    actions[player_slot] = 0
+    legal[player_slot] = [0, 1]
+    rec.step(hid, actions, legal, [None] * n_players, player_slot)
+
+
+def test_recorder_open_validation_and_unknown_hid():
+    rec = HarvestRecorder(_gen_args())
+    with pytest.raises(HarvestError):
+        rec.open_episode([], [])
+    with pytest.raises(HarvestError):
+        rec.open_episode([0, 1], ["only-one"])
+    with pytest.raises(HarvestError):
+        rec.step("h999", [0], [[0]], [None], 0)
+    with pytest.raises(HarvestError):
+        rec.close("h999", [1.0])
+
+
+def test_recorder_step_arity_mismatch_drops_episode(capsys):
+    rec = HarvestRecorder(_gen_args())
+    hid = _open_pair(rec)
+    _valid_row(rec, hid)
+    rec.step(hid, [0], [[0]], [None], 0)  # 1 != 2 players
+    assert rec.close(hid, [1.0, -1.0]) is None
+    assert rec.stats()["flywheel_dropped_malformed"] == 1
+    assert rec.stats()["flywheel_episodes"] == 0
+    assert "malformed" in capsys.readouterr().out
+
+
+def test_recorder_action_without_captured_policy_drops_episode():
+    rec = HarvestRecorder(_gen_args())
+    hid = _open_pair(rec)
+    # the client reports an action the server never inferred: the prob
+    # would be a fabrication — poison for the importance weights
+    rec.step(hid, [0, None], [[0, 1], None], [None, None], 0)
+    assert rec.close(hid, [1.0, -1.0]) is None
+    assert rec.stats()["flywheel_dropped_malformed"] == 1
+
+
+def test_recorder_truncated_drops():
+    rec = HarvestRecorder(_gen_args())
+
+    hid = _open_pair(rec)
+    _valid_row(rec, hid)
+    assert rec.close(hid, None) is None  # outcome missing
+
+    hid = rec.open_episode([0, 1], ["sc", "sd"])
+    _valid_row(rec, hid, sid="sc")
+    assert rec.close(hid, [1.0]) is None  # outcome mis-sized
+
+    hid = rec.open_episode([0, 1], ["se", "sf"])
+    assert rec.close(hid, [1.0, -1.0]) is None  # zero rows
+
+    stats = rec.stats()
+    assert stats["flywheel_dropped_truncated"] == 3
+    assert stats["flywheel_episodes"] == 0
+
+
+def test_recorder_ttl_sweep_drops_abandoned_sessions():
+    rec = HarvestRecorder(_gen_args(), ttl_s=5.0)
+    hid = _open_pair(rec)
+    assert rec.sweep(now=time.monotonic() + 1.0) == 0
+    assert rec.sweep(now=time.monotonic() + 60.0) == 1
+    with pytest.raises(HarvestError):
+        rec.close(hid, [1.0, -1.0])
+    assert rec.stats()["flywheel_dropped_truncated"] == 1
+    assert rec.stats()["flywheel_open"] == 0
+
+
+def test_recorder_max_open_sheds_oldest():
+    rec = HarvestRecorder(_gen_args(), max_open=2)
+    h1 = rec.open_episode([0], ["m1"])
+    rec.open_episode([0], ["m2"])
+    rec.open_episode([0], ["m3"])  # sheds h1, the oldest
+    assert rec.stats()["flywheel_open"] == 2
+    assert rec.stats()["flywheel_dropped_truncated"] == 1
+    with pytest.raises(HarvestError):
+        rec.close(h1, [1.0])
+
+
+def test_recorder_pull_transfers_ownership_and_counts():
+    rec = HarvestRecorder(_gen_args())
+    for sid in ("p1", "p2"):
+        hid = rec.open_episode([0], [sid])
+        _valid_row(rec, hid, sid=sid, n_players=1)
+        ep = rec.close(hid, [1.0])
+        assert ep is not None and ep["steps"] == 1 and ep["blocks"]
+
+    eps, counts = rec.pull(max_episodes=1)
+    assert len(eps) == 1 and counts["flywheel_queued"] == 1
+    eps2, counts = rec.pull(max_episodes=8)
+    assert len(eps2) == 1 and counts["flywheel_queued"] == 0
+    assert rec.pull()[0] == []
+    stats = rec.stats()
+    assert stats["flywheel_pulled"] == 2 and stats["flywheel_episodes"] == 2
+
+
+# ---------------------------------------------------------------------------
+# HarvestIngestor guards (learner side)
+# ---------------------------------------------------------------------------
+
+
+def _blob(epoch):
+    return {"args": {}, "steps": 1, "players": [0], "outcome": {0: 1.0},
+            "blocks": [b""], "model_epoch": epoch}
+
+
+def _ingestor(fraction, update_episodes, staleness=4, epoch_box=None):
+    epoch_box = epoch_box if epoch_box is not None else [10]
+    got = []
+    ing = HarvestIngestor(
+        {"harvest_fraction": fraction, "update_episodes": update_episodes,
+         "staleness_epochs": staleness, "harvest_poll_s": 0.01,
+         "harvest_max_pull": 8},
+        submit=got.extend,
+        current_epoch=lambda: epoch_box[0],
+        make_client=lambda: None,
+    )
+    return ing, got, epoch_box
+
+
+def test_ingest_drops_malformed_blobs(capsys):
+    ing, got, _ = _ingestor(1.0, 0)
+    n = ing.ingest([{"bogus": 1}, "not-even-a-dict", _blob(10)])
+    assert n == 1 and len(got) == 1
+    assert ing.stats()["flywheel_ingest_malformed"] == 2
+    assert "malformed" in capsys.readouterr().out
+
+
+def test_ingest_staleness_boundary():
+    ing, got, _ = _ingestor(1.0, 0, staleness=4)  # current epoch 10
+    assert ing.ingest([_blob(6)]) == 0   # 10 - 6 >= 4: stale
+    assert ing.ingest([_blob(7)]) == 1   # one inside the bound
+    assert ing.stats()["flywheel_ingest_stale"] == 1
+    assert [e["model_epoch"] for e in got] == [7]
+
+
+def test_ingest_budget_defers_over_fraction_to_next_epoch():
+    ing, got, epoch = _ingestor(0.5, 8, staleness=100, epoch_box=[5])
+    assert ing.epoch_budget == 4
+    assert ing.ingest([_blob(5) for _ in range(6)]) == 4   # budget for epoch 5
+    assert len(got) == 4
+    assert ing.ingest([_blob(5)]) == 0                     # budget exhausted
+    epoch[0] = 6
+    assert ing.ingest([]) == 3                             # deferred re-enter
+    assert len(got) == 7
+    assert ing.stats()["flywheel_ingested"] == 7
+
+
+def test_ingest_full_fraction_is_unthrottled():
+    ing, got, _ = _ingestor(1.0, 8)
+    assert ing.epoch_budget is None
+    assert ing.ingest([_blob(10) for _ in range(50)]) == 50
+    assert len(got) == 50
+
+
+# ---------------------------------------------------------------------------
+# quality plane: ledger, promotion gate, sentinel, signal files
+# ---------------------------------------------------------------------------
+
+
+class _StubRouter:
+    """Routing-table-only double for ModelRouter's gate surface."""
+
+    def __init__(self, template):
+        self._template = template
+        self._latest = None
+        self._candidate = None
+        self._incumbent = None
+        self.staged = []
+        self.refreshed = None
+
+    def latest_id(self):
+        return self._latest
+
+    def candidate_id(self):
+        return self._candidate
+
+    def incumbent_id(self):
+        return self._incumbent
+
+    def _params_template(self):
+        return self._template
+
+    def stage(self, model_id, params, warm=True):
+        self._candidate = int(model_id)
+        self.staged.append((int(model_id), params))
+
+    def promote_candidate(self):
+        self._incumbent, self._latest = self._latest, self._candidate
+        self._candidate = None
+        return self._latest
+
+    def demote_candidate(self):
+        demoted, self._candidate = self._candidate, None
+        return demoted
+
+    def demote_latest(self):
+        bad = self._latest
+        self._latest, self._incumbent = self._incumbent, None
+        return bad
+
+    def maybe_refresh(self):
+        return self.refreshed
+
+
+def _qc(tmp_path, router, **over):
+    cfg = {"gate_promotions": True, "promote_winrate": 0.6,
+           "promote_games": 4, "quality_window": 3, "demote_drop": 0.1}
+    cfg.update(over)
+    return QualityController(router, str(tmp_path), cfg)
+
+
+def _save(tmp_path, epoch, fill):
+    save_epoch_snapshot(
+        str(tmp_path), epoch, {"w": np.full((2, 2), fill, np.float32)},
+        {"test": 0}, 0,
+    )
+
+
+def test_gate_stages_then_promotes_on_live_wins(tmp_path):
+    router = _StubRouter({"w": np.zeros((2, 2), np.float32)})
+    qc = _qc(tmp_path, router)
+    _save(tmp_path, 1, 1.0)
+
+    assert qc.tick() == "staged candidate epoch 1"
+    assert router.candidate_id() == 1
+    np.testing.assert_array_equal(
+        router.staged[0][1]["w"], np.full((2, 2), 1.0, np.float32)
+    )
+    assert qc.tick() is None  # verdict needs promote_games on the books
+
+    for outcome in (1.0, 1.0, 1.0, -1.0):  # wp 0.75 >= 0.6
+        qc.record_outcome(1, outcome)
+    event = qc.tick()
+    assert event is not None and event.startswith("promoted epoch 1")
+    assert router.latest_id() == 1 and router.candidate_id() is None
+    assert qc.stats_record()["quality_promotions"] == 1
+    # SERVING.json pins the live route for gc_snapshots
+    assert serving_pinned_epochs(str(tmp_path)) == {1}
+
+
+def test_gate_failure_demotes_signals_and_never_restages(tmp_path):
+    router = _StubRouter({"w": np.zeros((2, 2), np.float32)})
+    router._latest = 5
+    qc = _qc(tmp_path, router)
+    _save(tmp_path, 6, 6.0)
+
+    assert qc.tick() == "staged candidate epoch 6"
+    for _ in range(4):
+        qc.record_outcome(6, -1.0)  # wp 0.0 < 0.6
+    event = qc.tick()
+    assert event is not None and event.startswith("gate failed for epoch 6")
+    assert router.candidate_id() is None and router.latest_id() == 5
+
+    sig = read_rollback_signal(str(tmp_path))
+    assert sig == {"seq": 1, "bad_epoch": 6, "target_epoch": 5,
+                   "reason": "gate_failed"}
+    # a rejected epoch never comes back as a candidate
+    assert qc.tick() is None
+    assert len(router.staged) == 1
+    assert qc.stats_record()["quality_gate_failures"] == 1
+
+
+def test_quality_sentinel_demotes_regressed_promotion(tmp_path):
+    router = _StubRouter({"w": np.zeros((2, 2), np.float32)})
+    router._latest = 1
+    qc = _qc(tmp_path, router, promote_games=2)
+    for _ in range(3):
+        qc.record_outcome(1, 1.0)  # incumbent baseline EMA = 1.0
+    _save(tmp_path, 2, 2.0)
+
+    assert qc.tick() == "staged candidate epoch 2"
+    qc.record_outcome(2, 1.0)
+    qc.record_outcome(2, 1.0)
+    event = qc.tick()
+    assert event is not None and event.startswith("promoted epoch 2")
+    assert router.incumbent_id() == 1
+
+    # live quality craters past quality_window games: EMA sinks under
+    # baseline - demote_drop and the sentinel restores the incumbent
+    for _ in range(3):
+        qc.record_outcome(2, -1.0)
+    event = qc.tick()
+    assert event is not None and "demoted epoch 2" in event
+    assert "restored incumbent 1" in event
+    assert router.latest_id() == 1
+    sig = read_rollback_signal(str(tmp_path))
+    assert sig["bad_epoch"] == 2 and sig["target_epoch"] == 1
+    assert sig["reason"] == "quality_regression"
+    assert qc.stats_record()["quality_demotions"] == 1
+    # demoted epochs are rejected: the stale snapshot never re-stages
+    assert qc.tick() is None and router.candidate_id() is None
+
+
+def test_quality_sentinel_watch_is_a_bounded_canary(tmp_path):
+    """A promotion that holds its quality through 4 EMA windows of live
+    games is CONFIRMED — later noise can never demote it.  An unbounded
+    watch would eventually demote every promotion (an EMA random-walks
+    below any sub-mean bar given enough games), each time costing a
+    training-side rollback."""
+    router = _StubRouter({"w": np.zeros((2, 2), np.float32)})
+    router._latest = 1
+    qc = _qc(tmp_path, router, promote_games=2, quality_window=3)
+    for _ in range(3):
+        qc.record_outcome(1, 1.0)
+    _save(tmp_path, 2, 2.0)
+    assert qc.tick() == "staged candidate epoch 2"
+    qc.record_outcome(2, 1.0)
+    qc.record_outcome(2, 1.0)
+    assert qc.tick().startswith("promoted epoch 2")
+
+    # 4 * quality_window healthy games confirm the promotion ...
+    for _ in range(12):
+        qc.record_outcome(2, 1.0)
+        assert qc.tick() is None
+    # ... after which even a catastrophic losing streak cannot demote
+    for _ in range(20):
+        qc.record_outcome(2, -1.0)
+        assert qc.tick() is None
+    assert router.latest_id() == 2
+    assert qc.stats_record()["quality_demotions"] == 0
+    assert read_rollback_signal(str(tmp_path)) is None
+
+
+def test_gate_off_degrades_to_immediate_refresh(tmp_path):
+    router = _StubRouter({"w": np.zeros((2, 2), np.float32)})
+    router.refreshed = 4
+    qc = _qc(tmp_path, router, gate_promotions=False)
+    assert qc.tick() == "published epoch 4"
+    assert router.staged == []
+
+
+def test_ledger_ignores_fresh_init_and_counts_its_own_games():
+    ledger = QualityLedger(window=8)
+    ledger.record(0, 1.0)   # id 0 is the fresh-init route, not a snapshot
+    ledger.record(-1, 1.0)
+    assert ledger.total_games() == 0
+    ledger.record(2, 1.0)
+    ledger.record(2, -1.0)
+    assert ledger.total_games() == 2
+    assert ledger.games(2) == 2
+    assert ledger.win_points(2) == pytest.approx(0.5)
+    assert ledger.snapshot()["quality_wp2"] == pytest.approx(0.5)
+    assert 0.0 < ledger.ema(2) < 1.0
+
+
+def test_record_outcome_rejects_garbage(tmp_path):
+    qc = _qc(tmp_path, _StubRouter({"w": np.zeros(1, np.float32)}))
+    with pytest.raises(ValueError):
+        qc.record_outcome("five", "lost")
+
+
+def test_rollback_signal_seq_is_monotone(tmp_path):
+    assert read_rollback_signal(str(tmp_path)) is None
+    assert write_rollback_signal(str(tmp_path), 3, 2, "gate_failed") == 1
+    assert write_rollback_signal(str(tmp_path), 5, 4, "quality_regression") == 2
+    sig = read_rollback_signal(str(tmp_path))
+    assert sig["seq"] == 2 and sig["bad_epoch"] == 5
+
+
+def test_serving_pinned_epochs_filters_non_snapshots(tmp_path):
+    assert serving_pinned_epochs(str(tmp_path)) == set()
+    write_serving_state(str(tmp_path), 3, None, 2)
+    assert serving_pinned_epochs(str(tmp_path)) == {3, 2}
+    write_serving_state(str(tmp_path), 0, -1, 4)
+    assert serving_pinned_epochs(str(tmp_path)) == {4}
+
+
+# ---------------------------------------------------------------------------
+# transient-fault retry discipline (utils/retry.py + its two call sites)
+# ---------------------------------------------------------------------------
+
+
+def test_retry_backoff_schedule_then_success():
+    calls, sleeps, retries = [], [], []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("flake")
+        return 7
+
+    got = retry_call(fn, attempts=3, base_delay=0.1, factor=2.0,
+                     max_delay=0.15, sleep=sleeps.append,
+                     on_retry=lambda i, exc: retries.append(i))
+    assert got == 7 and len(calls) == 3
+    assert sleeps == [pytest.approx(0.1), pytest.approx(0.15)]  # capped
+    assert retries == [0, 1]
+
+
+def test_retry_exhaustion_raises_the_last_error():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise TimeoutError(f"try {len(calls)}")
+
+    with pytest.raises(TimeoutError, match="try 3"):
+        retry_call(fn, attempts=2, base_delay=0.0, sleep=lambda s: None)
+    assert len(calls) == 3  # first try + 2 retries
+
+
+def test_retry_non_retryable_propagates_immediately():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("logic bug, not a flake")
+
+    with pytest.raises(ValueError):
+        retry_call(fn, attempts=5, base_delay=0.0, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_retry_attempts_zero_is_a_single_try():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_call(fn, attempts=0, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_actor_host_reconnect_shape_reissues_same_request():
+    """The actor-host poll seam, socket-free: a wedged client fails the
+    call, on_retry swaps in a freshly-dialed client, and retry_call
+    re-issues the SAME request against it (actor_host.py _reconnect)."""
+
+    class _Wedged:
+        polls = 0
+        closed = False
+
+        def poll_params(self):
+            self.polls += 1
+            raise ConnectionError("reset mid-frame")
+
+        def close(self):
+            self.closed = True
+
+    class _Healthy:
+        polls = 0
+
+        def poll_params(self):
+            self.polls += 1
+            return (3, {"w": 1})
+
+    wedged, healthy = _Wedged(), _Healthy()
+    client = wedged
+
+    def _reconnect(i, exc):
+        nonlocal client
+        client.close()
+        client = healthy
+
+    got = retry_call(lambda: client.poll_params(), attempts=3,
+                     base_delay=0.0, sleep=lambda s: None,
+                     on_retry=_reconnect)
+    assert got == (3, {"w": 1})
+    assert wedged.polls == 1 and wedged.closed
+    assert healthy.polls == 1
+
+
+def _bare_fleet_router(attempts=2):
+    from handyrl_tpu.fleet.router_tier import FleetRouter
+
+    fr = FleetRouter.__new__(FleetRouter)
+    fr.poll_retry_attempts = attempts
+    fr.poll_retry_backoff_s = 0.001
+    fr.stats_poll_s = 0.01
+    fr._stats_lock = threading.Lock()
+    fr.poll_retries = 0
+    return fr
+
+
+def test_fleet_stats_poll_retries_transient_faults():
+    fr = _bare_fleet_router(attempts=2)
+    n = [0]
+
+    class _FlakyClient:
+        def stats(self, timeout=None):
+            n[0] += 1
+            if n[0] < 3:
+                raise ConnectionError("storm")
+            return {"serve_models": 1}
+
+    got = fr._replica_stats(types.SimpleNamespace(client=_FlakyClient()))
+    assert got == {"serve_models": 1}
+    assert n[0] == 3 and fr.poll_retries == 2
+
+
+def test_fleet_stats_poll_exhausts_then_raises():
+    fr = _bare_fleet_router(attempts=1)
+
+    class _DeadClient:
+        def stats(self, timeout=None):
+            raise TimeoutError("gone")
+
+    with pytest.raises(TimeoutError):
+        fr._replica_stats(types.SimpleNamespace(client=_DeadClient()))
+    assert fr.poll_retries == 1
+
+
+def test_fleet_stats_poll_server_reported_error_never_retries():
+    from handyrl_tpu.serving import ServingError
+
+    fr = _bare_fleet_router(attempts=5)
+    n = [0]
+
+    class _Misbehaving:
+        def stats(self, timeout=None):
+            n[0] += 1
+            raise ServingError("bad_request", "peer misbehaving")
+
+    with pytest.raises(ServingError):
+        fr._replica_stats(types.SimpleNamespace(client=_Misbehaving()))
+    assert n[0] == 1 and fr.poll_retries == 0
+
+
+def test_fleet_stats_poll_clientless_replica_is_connection_error():
+    fr = _bare_fleet_router()
+    with pytest.raises(ConnectionError):
+        fr._replica_stats(types.SimpleNamespace(client=None))
+    assert fr.poll_retries == 0
+
+
+# ---------------------------------------------------------------------------
+# config validation pins
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("knob, value, match", [
+    ("harvest_fraction", 1.5, "must be in \\[0, 1\\]"),
+    ("staleness_epochs", 0, "staleness_epochs"),
+    ("promote_winrate", 1.0, "must be in \\(0, 1\\)"),
+    ("harvest_port", "9997", "TCP port"),
+    ("harvest_poll_s", 0.0, "must be > 0"),
+    ("gate_promotions", 1, "must be a bool"),
+])
+def test_flywheel_config_validation(knob, value, match):
+    with pytest.raises(ValueError, match=match):
+        normalize_args({
+            "env_args": {"env": "TicTacToe"},
+            "train_args": {"flywheel": {knob: value}},
+        })
+
+
+@pytest.mark.parametrize("knob, value", [
+    ("poll_retry_attempts", -1),
+    ("poll_retry_backoff_s", 0.0),
+])
+def test_fleet_retry_config_validation(knob, value):
+    with pytest.raises(ValueError, match=knob):
+        normalize_args({
+            "env_args": {"env": "TicTacToe"},
+            "train_args": {"fleet": {knob: value}},
+        })
+
+
+# ---------------------------------------------------------------------------
+# flagship e2e: serve + train on served traffic only, gated promotions,
+# poisoned-snapshot auto-demote + training-side rollback
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_flywheel_e2e_served_traffic_trains_gates_and_rolls_back(
+        tmp_path, monkeypatch, capsys):
+    import jax
+
+    from handyrl_tpu.flywheel import FlywheelPlane
+    from handyrl_tpu.models import init_variables
+    from handyrl_tpu.runtime.checkpoint import load_verified_params
+    from handyrl_tpu.runtime.learner import Learner
+    from handyrl_tpu.serving import ModelRouter, ServingClient, ServingError, ServingServer
+
+    monkeypatch.chdir(tmp_path)
+    EPOCHS, UPDATE_EPISODES, POISON = 30, 120, 20
+    monkeypatch.setenv("HANDYRL_FAULT_POISON_SNAPSHOT_AT_EPOCH", str(POISON))
+
+    fly_cfg = {
+        "enabled": True,
+        "harvest_fraction": 1.0,      # served traffic ONLY — zero self-play
+        "staleness_epochs": 8,
+        "harvest_poll_s": 0.1,
+        "harvest_max_pull": 256,
+        "gate_promotions": True,
+        "promote_winrate": 0.35,      # clean snapshots clear this vs random
+        "promote_games": 12,          # verdicts resolve inside one epoch
+        "quality_window": 16,         # canary confirms after 64 live games
+        "demote_drop": 0.25,
+        "shadow_fraction": 1.0,       # all default-route traffic shadows the
+                                      # candidate: clean outcome attribution
+    }
+    serving_cfg = {
+        "port": 0, "max_models": 4, "slo_ms": 2000.0, "shed_policy": "none",
+        "max_batch": 64, "max_wait_ms": 1.0, "warm_buckets": [1, 2, 4, 8, 16],
+        "queue_bound": 8192, "recv_timeout": 0.0, "watch_interval": 0.2,
+        "stats_interval": 0.0,
+    }
+
+    env = make_env({"env": "TicTacToe"})
+    module = env.net()
+    env.reset()
+    obs0 = env.observation(0)
+    template = init_variables(module, env, seed=0)["params"]
+
+    # serving side first: the cold router serves a fresh-init net under
+    # id 0 (serve_main's cold start) so clients have traffic from t=0
+    router = ModelRouter(module, obs0, serving_cfg, model_dir="models")
+    router.publish(0, template)
+    targs_probe = _targs()
+    flywheel = FlywheelPlane(router, "models", fly_cfg, _gen_args(targs_probe))
+    server = ServingServer(router, serving_cfg, flywheel=flywheel).run()
+
+    stop = threading.Event()
+    books = []          # (eval outcomes for the served side, time-ordered)
+    books_lock = threading.Lock()
+    client_errors = []
+
+    def _harvest_game(client, game_env, rng):
+        players = game_env.players()
+        sids = [client.open_session() for _ in players]
+        hid = client.harvest_open(players, sids)
+        game_env.reset()
+        while not game_env.terminal():
+            turn_players = game_env.turns()
+            actions = [None] * len(players)
+            legal_lists = [None] * len(players)
+            moves = {}
+            for p in turn_players:
+                j = players.index(p)
+                reply = client.infer(game_env.observation(p), sid=sids[j])
+                logits = np.asarray(reply["out"]["policy"], np.float32).reshape(-1)
+                legal = list(game_env.legal_actions(p))
+                amask = np.full_like(logits, 1e32)
+                amask[legal] = 0.0
+                probs = softmax(logits - amask)
+                action = rng.choices(
+                    legal, weights=[float(probs[a]) for a in legal]
+                )[0]
+                actions[j] = int(action)
+                legal_lists[j] = legal
+                moves[p] = int(action)
+            turn = turn_players[0] if turn_players else None
+            game_env.step(moves)
+            reward = game_env.reward()
+            client.harvest_step(
+                hid, actions, legal_lists,
+                [reward.get(p) for p in players], turn,
+            )
+        outcome = game_env.outcome()
+        client.harvest_close(hid, [float(outcome.get(p, 0.0)) for p in players])
+        for sid in sids:
+            client.close_session(sid)
+
+    def _eval_game(client, game_env, rng, seat):
+        """Served (greedy) vs scripted-random, alternating seats; the
+        outcome lands on the served snapshot's live books."""
+        game_env.reset()
+        served_id = None
+        while not game_env.terminal():
+            moves = {}
+            for p in game_env.turns():
+                legal = list(game_env.legal_actions(p))
+                if p == seat:
+                    reply = client.infer(game_env.observation(p))
+                    if served_id is None and isinstance(reply.get("model"), int):
+                        served_id = reply["model"]
+                    logits = np.asarray(reply["out"]["policy"]).reshape(-1)
+                    action = max(legal, key=lambda a: (logits[a], rng.random()))
+                else:
+                    action = rng.choice(legal)
+                moves[p] = int(action)
+            game_env.step(moves)
+        outcome = float(game_env.outcome().get(seat, 0.0))
+        if served_id is not None and served_id > 0:
+            client.report_outcome(served_id, outcome)
+        with books_lock:
+            books.append(outcome)
+
+    def _client_loop(idx):
+        rng = random.Random(1000 + idx)
+        game_env = make_env({"env": "TicTacToe"})
+        client = ServingClient("127.0.0.1", server.bound_port)
+        g = 0
+        try:
+            while not stop.is_set():
+                g += 1
+                try:
+                    if g % 3 == 0:
+                        _eval_game(client, game_env, rng, seat=(g // 3) % 2)
+                    else:
+                        _harvest_game(client, game_env, rng)
+                except ServingError:
+                    continue  # shed/evicted mid-request during a flip
+                except (ConnectionError, OSError, TimeoutError):
+                    if stop.is_set():
+                        return
+                    time.sleep(0.1)
+        except Exception as exc:  # anything else is a real bug — surface it
+            client_errors.append(f"client {idx}: {type(exc).__name__}: {exc}")
+        finally:
+            try:
+                client.close()
+            except Exception:
+                pass
+
+    threads = [
+        threading.Thread(target=_client_loop, args=(i,), daemon=True)
+        for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+
+    try:
+        args = normalize_args({
+            "env_args": {"env": "TicTacToe"},
+            "train_args": {
+                "batch_size": 64,
+                "forward_steps": 8,
+                "minimum_episodes": UPDATE_EPISODES,
+                "update_episodes": UPDATE_EPISODES,
+                "maximum_episodes": 3000,
+                "epochs": EPOCHS,
+                "num_batchers": 1,
+                "worker": {"num_parallel": 0},  # self-play fraction: ZERO
+                "flywheel": dict(fly_cfg, harvest_port=server.bound_port),
+            },
+        })
+        learner = Learner(args)
+        learner.run()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+
+    assert not client_errors, client_errors
+
+    # -- the learner really trained, on harvested episodes only -----------
+    records = [json.loads(l) for l in open("metrics.jsonl")]
+    assert len(records) >= EPOCHS
+    trained = [r for r in records if r.get("loss") is not None]
+    assert trained, "no training epochs recorded"
+    for r in trained:
+        assert np.isfinite(float(r["loss"]["total"])), r["loss"]
+    ingested = max(r.get("flywheel_ingested", 0) for r in records)
+    assert ingested >= UPDATE_EPISODES * (EPOCHS // 2), (
+        f"only {ingested} harvested episodes ingested"
+    )
+
+    # -- live win rate vs the scripted clients CLIMBS ----------------------
+    assert len(books) >= 200, f"only {len(books)} eval games played"
+    k = max(1, int(len(books) * 0.4))
+    early = float(np.mean(books[:k]))
+    late = float(np.mean(books[-k:]))
+    assert late > early, (
+        f"no live climb: early {early:.3f} -> late {late:.3f} "
+        f"over {len(books)} eval games"
+    )
+
+    # -- >= 1 gated promotion recorded ------------------------------------
+    quality = flywheel.stats_record()
+    assert quality["quality_promotions"] >= 1, quality
+
+    # -- the poisoned snapshot was auto-demoted on the serving side --------
+    out = capsys.readouterr().out
+    assert (f"gate failed for epoch {POISON}" in out
+            or f"demoted epoch {POISON}" in out), (
+        f"poisoned epoch {POISON} never demoted by the quality plane"
+    )
+    assert router.latest_id() != POISON
+    assert router.candidate_id() != POISON
+
+    # -- ... and rolled back on the training side --------------------------
+    assert learner.flywheel_rollbacks >= 1
+    assert learner.trainer.sentinel_events.get(
+        "sentinel_flywheel_rollbacks", 0
+    ) >= 1
+    sig = read_rollback_signal("models")
+    assert sig is not None and sig["seq"] >= 1
+
+    # -- the incumbent is restored BIT-IDENTICALLY -------------------------
+    latest = router.latest_id()
+    assert latest is not None and latest > 0 and latest != POISON
+    served_params = jax.device_get(
+        router._engines[latest].model.variables["params"]
+    )
+    disk_params = load_verified_params("models", latest, template)
+    served_leaves = jax.tree.leaves(served_params)
+    disk_leaves = jax.tree.leaves(disk_params)
+    assert len(served_leaves) == len(disk_leaves)
+    for sl, dl in zip(served_leaves, disk_leaves):
+        np.testing.assert_array_equal(np.asarray(sl), np.asarray(dl))
+
+    server.shutdown()

@@ -243,6 +243,10 @@ class LintConfig:
         # boundary, not an accidental sync
         "handyrl_tpu/runtime/plane.py",
         "handyrl_tpu/runtime/actor_host.py",
+        # the flywheel's harvest capture seams run INSIDE the serving
+        # request path (_do_infer / _reply) and its quality tick inside
+        # the watch loop: a stray host sync is a per-request regression
+        "handyrl_tpu/flywheel/*.py",
     )
     # functions (bare names) that are drain/teardown/construction paths —
     # host syncs there are the POINT, not a leak
@@ -289,6 +293,10 @@ class LintConfig:
         # batchers route through dispatch_serialized; direct dispatches in
         # the quantize module itself must hold the same lock discipline
         "handyrl_tpu/models/quantize.py",
+        # the flywheel stages candidate engines onto the same chips the
+        # router's serving engines occupy — any device dispatch it grows
+        # must hold the same explicit scope
+        "handyrl_tpu/flywheel/*.py",
     )
     dispatch_wrapper: str = "dispatch_serialized"
 
@@ -299,7 +307,7 @@ class LintConfig:
     # every other dict-valued default (mesh, ...) is one knob
     cfg005_nested: Tuple[str, ...] = (
         "worker", "distributed", "eval", "serving", "league", "trace",
-        "observability", "fleet",
+        "observability", "fleet", "flywheel",
         # second-level section: the autoscaler's knobs are documented
         # per-knob (fleet.autoscale.enabled, ...), not as one opaque dict
         "fleet.autoscale",
@@ -317,6 +325,11 @@ class LintConfig:
         "handyrl_tpu/league/learner.py",
         "handyrl_tpu/fleet/router_tier.py",
         "handyrl_tpu/fleet/sessions.py",
+        # the flywheel's stats_record feeds both the serving server's
+        # periodic record and the learner's per-epoch record
+        "handyrl_tpu/flywheel/harvest.py",
+        "handyrl_tpu/flywheel/quality.py",
+        "handyrl_tpu/flywheel/ingest.py",
     )
     # module-level *_KEYS tuples that feed metrics keys, with the prefix
     # they are written under
